@@ -1,0 +1,26 @@
+"""k8s_gpu_scheduler_tpu — a TPU-native Kubernetes-style scheduling framework.
+
+A ground-up rebuild of the capabilities of dimgatz98/k8s-gpu-scheduler
+(reference mounted at /root/reference) for GKE TPU node pools:
+
+- ``api``:       typed object model (Pod, Node, ConfigMap, PodGroup) plus TPU
+                 slice topology math (ICI torus coordinates).
+- ``cluster``:   hermetic in-memory API server with watch streams, and
+                 client-go-style shared informers / listers / indexers.
+- ``sched``:     the scheduling framework itself (queue, cache, cycle,
+                 Filter/Score/Reserve/Permit/PostBind plugin points) plus the
+                 TPU plugin — the analogue of the reference's out-of-tree GPU
+                 plugin (reference: pkg/plugins/gpu_plugin/gpu_plugins.go).
+- ``registry``:  chip-inventory KV registry (C++ RESP server under native/,
+                 socket client here) — parity with pkg/redis/client.
+- ``metrics``:   Prometheus instant-query layer for the TPU device-plugin
+                 exporter — parity with pkg/prom.
+- ``recommender``: throughput/interference imputation service (gRPC) with a
+                 JAX-native iterative imputer — parity with pkg/recommender.
+- ``agent``:     per-node inventory/utilization publisher fed by the C++
+                 prober under native/ — parity with pkg/profiler.
+- ``models``/``ops``/``parallel``: the JAX workload layer the scheduler
+                 places (Llama/BERT/ResNet; pallas kernels; mesh shardings).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,154 @@
+"""Shared informers, listers and indexers over the APIServer watch stream.
+
+Mirrors the client-go machinery the reference bootstraps lazily inside Score
+(gpu_plugins.go:785-796: NewSharedInformerFactory → configmap/pod listers →
+configmap/node/pod indexers → Start + WaitForCacheSync) — but built once at
+scheduler construction, not per-Score-call, and without package-level mutable
+globals (the reference's latent race, SURVEY.md §5 "Race detection").
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from .apiserver import APIServer, Watch, WatchEvent
+
+
+class Informer:
+    def __init__(self, server: APIServer, kind: str) -> None:
+        self._server = server
+        self.kind = kind
+        self._mu = threading.Lock()
+        self._cache: Dict[str, Any] = {}
+        self._synced = threading.Event()
+        self._watch: Optional[Watch] = None
+        self._thread: Optional[threading.Thread] = None
+        self._handlers: List[Dict[str, Callable[..., None]]] = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        # Initial list under the same subscription guarantees no missed events.
+        self._watch = self._server.watch(self.kind, send_initial=True)
+        with self._mu:
+            for obj in self._server.list(self.kind):
+                self._cache[obj.metadata.key] = obj
+        self._synced.set()
+        self._thread = threading.Thread(
+            target=self._run, name=f"informer-{self.kind}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._watch is not None:
+            self._watch.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        assert self._watch is not None
+        while True:
+            ev = self._watch.next()
+            if ev is None:
+                return
+            self._apply(ev)
+
+    def _apply(self, ev: WatchEvent) -> None:
+        key = ev.obj.metadata.key
+        old = None
+        with self._mu:
+            old = self._cache.get(key)
+            if ev.type == "DELETED":
+                self._cache.pop(key, None)
+            else:
+                # Drop stale events (ABA on out-of-order delivery).
+                if old is not None and old.metadata.resource_version >= ev.obj.metadata.resource_version:
+                    return
+                self._cache[key] = ev.obj
+        for h in self._handlers:
+            if ev.type == "ADDED" and "on_add" in h:
+                h["on_add"](ev.obj)
+            elif ev.type == "MODIFIED" and "on_update" in h:
+                h["on_update"](old, ev.obj)
+            elif ev.type == "DELETED" and "on_delete" in h:
+                h["on_delete"](ev.obj)
+
+    def add_event_handler(
+        self,
+        on_add: Optional[Callable[[Any], None]] = None,
+        on_update: Optional[Callable[[Any, Any], None]] = None,
+        on_delete: Optional[Callable[[Any], None]] = None,
+    ) -> None:
+        h: Dict[str, Callable[..., None]] = {}
+        if on_add:
+            h["on_add"] = on_add
+        if on_update:
+            h["on_update"] = on_update
+        if on_delete:
+            h["on_delete"] = on_delete
+        self._handlers.append(h)
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    # -- lister / indexer --------------------------------------------------
+    def list(
+        self,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        filter_fn: Optional[Callable[[Any], bool]] = None,
+    ) -> List[Any]:
+        with self._mu:
+            out = []
+            for obj in self._cache.values():
+                if namespace is not None and obj.metadata.namespace != namespace:
+                    continue
+                if label_selector and any(
+                    obj.metadata.labels.get(k) != v for k, v in label_selector.items()
+                ):
+                    continue
+                if filter_fn is not None and not filter_fn(obj):
+                    continue
+                out.append(obj)
+            return out
+
+    def get(self, name: str, namespace: str = "default") -> Optional[Any]:
+        """Indexer GetByKey — parity with resources.Descriptor.Get
+        (pkg/resources/pods.go:87-96); returns None on miss instead of the
+        reference's hardcoded-key bug (nodes.go:28-37)."""
+        with self._mu:
+            return self._cache.get(f"{namespace}/{name}")
+
+
+class SharedInformerFactory:
+    def __init__(self, server: APIServer) -> None:
+        self._server = server
+        self._mu = threading.Lock()
+        self._informers: Dict[str, Informer] = {}
+
+    def informer(self, kind: str) -> Informer:
+        with self._mu:
+            inf = self._informers.get(kind)
+            if inf is None:
+                inf = Informer(self._server, kind)
+                self._informers[kind] = inf
+            return inf
+
+    def start(self) -> None:
+        with self._mu:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.start()
+
+    def wait_for_cache_sync(self, timeout: float = 5.0) -> bool:
+        with self._mu:
+            informers = list(self._informers.values())
+        return all(inf._synced.wait(timeout) for inf in informers)
+
+    def stop(self) -> None:
+        with self._mu:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.stop()

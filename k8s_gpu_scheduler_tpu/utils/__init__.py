@@ -1,0 +1,6 @@
+from .discovery import (  # noqa: F401
+    exists_substring,
+    find_agent_pod_on_node,
+    find_node_from_pod,
+    find_nodes_ip_from_pod,
+)

@@ -1,0 +1,62 @@
+"""Service/pod discovery helpers — parity with /root/reference/utils/utils.go.
+
+The reference locates its Redis and dcgm-exporter endpoints by pod-name
+substring (FindNodesIPFromPod utils.go:59-70, GetNodesDcgmPod utils.go:72-99)
+— a convention we keep as the *fallback* while preferring explicit config
+(config.Registry/config.Metrics endpoints) because hardcoded substrings are
+one of the reference's weaknesses (SURVEY.md §5 "Config / flag system").
+
+No panic-on-error Check() (utils.go:18-22): errors are returned/raised and
+handled by callers.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..api.objects import Pod
+from ..cluster.resources import Descriptor
+
+
+def exists_substring(items: List[str], sub: str) -> bool:
+    """Parity with utils.Exists (utils.go:101-108)."""
+    return any(sub in s for s in items)
+
+
+def find_node_from_pod(desc: Descriptor, pod_substring: str, namespace: str) -> Optional[str]:
+    """Node name hosting the first pod whose name contains ``pod_substring``
+    (parity: FindNodeFromPod utils.go:24-57)."""
+    for pod in desc.list_pods(namespace=namespace):
+        if pod_substring in pod.metadata.name:
+            return pod.spec.node_name or None
+    return None
+
+
+def find_nodes_ip_from_pod(
+    desc: Descriptor, pod_substring: str, namespace: str
+) -> List[str]:
+    """Addresses of nodes hosting pods whose name contains ``pod_substring``
+    (parity: FindNodesIPFromPod utils.go:59-70 — how the reference discovers
+    Redis by looking for a pod named '*-0' in namespace 'redis')."""
+    out: List[str] = []
+    for pod in desc.list_pods(namespace=namespace):
+        if pod_substring in pod.metadata.name and pod.spec.node_name:
+            try:
+                node = desc.get_node(pod.spec.node_name)
+            except Exception:
+                continue
+            if node.status.addresses:
+                out.append(node.status.addresses[0])
+            else:
+                out.append(pod.spec.node_name)
+    return out
+
+
+def find_agent_pod_on_node(
+    desc: Descriptor, node_name: str, agent_substring: str = "tpu-agent", namespace: Optional[str] = None
+) -> Optional[Pod]:
+    """Find the node's metrics-agent pod (parity: GetNodesDcgmPod
+    utils.go:72-99, which looks for the 'dcgm' pod on a node)."""
+    for pod in desc.list_pods(namespace=namespace, node_name=node_name):
+        if agent_substring in pod.metadata.name:
+            return pod
+    return None

@@ -1,0 +1,13 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax loads.
+
+Multi-chip TPU hardware is unavailable in CI; all sharding tests run on
+XLA's host-platform device virtualization (8 CPU devices), which exercises
+the same GSPMD partitioner the TPU path uses.
+"""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,187 @@
+"""API server / informer / Descriptor tests — the hermetic cluster fixture
+the reference never had (its resource tests mutate a real dev cluster,
+SURVEY.md §4 'Live-infra integration')."""
+import threading
+import time
+
+import pytest
+
+from k8s_gpu_scheduler_tpu.api.objects import (
+    ConfigMap,
+    ConfigMapRef,
+    Container,
+    EnvVar,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+    TPU_RESOURCE,
+)
+from k8s_gpu_scheduler_tpu.cluster import APIServer, Descriptor, PatchNodeParam, SharedInformerFactory
+from k8s_gpu_scheduler_tpu.cluster.apiserver import AlreadyExists, NotFound
+from k8s_gpu_scheduler_tpu.utils import find_nodes_ip_from_pod
+
+
+def mk_pod(name, ns="default", node="", chips=0, cm_refs=(), env=()):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(
+            node_name=node,
+            containers=[
+                Container(
+                    env=[EnvVar(k, v) for k, v in env],
+                    env_from=[ConfigMapRef(r) for r in cm_refs],
+                    resources=ResourceRequirements(requests={TPU_RESOURCE: chips} if chips else {}),
+                )
+            ],
+        ),
+    )
+
+
+def mk_node(name, chips=8, addr=None, labels=None):
+    return Node(
+        metadata=ObjectMeta(name=name, namespace="default", labels=labels or {}),
+        status=NodeStatus(
+            capacity={TPU_RESOURCE: chips},
+            allocatable={TPU_RESOURCE: chips},
+            addresses=[addr or f"10.0.0.{hash(name) % 250}"],
+        ),
+    )
+
+
+class TestAPIServer:
+    def test_crud_roundtrip(self):
+        s = APIServer()
+        s.create(mk_pod("a"))
+        assert s.get("Pod", "a").metadata.name == "a"
+        with pytest.raises(AlreadyExists):
+            s.create(mk_pod("a"))
+        s.delete("Pod", "a")
+        with pytest.raises(NotFound):
+            s.get("Pod", "a")
+
+    def test_list_filters(self):
+        s = APIServer()
+        s.create(mk_pod("p1", ns="redis", node="n1"))
+        s.create(mk_pod("p2", ns="default", node="n1"))
+        s.create(mk_pod("p3", ns="default", node="n2"))
+        assert len(s.list("Pod")) == 3
+        assert len(s.list("Pod", namespace="default")) == 2
+        assert len(s.list("Pod", field_fn=lambda p: p.spec.node_name == "n1")) == 2
+
+    def test_deepcopy_isolation(self):
+        s = APIServer()
+        pod = mk_pod("a")
+        s.create(pod)
+        pod.spec.node_name = "mutated-outside"
+        assert s.get("Pod", "a").spec.node_name == ""
+        got = s.get("Pod", "a")
+        got.spec.node_name = "mutated-copy"
+        assert s.get("Pod", "a").spec.node_name == ""
+
+    def test_mutate_is_atomic_under_contention(self):
+        s = APIServer()
+        s.create(ConfigMap(metadata=ObjectMeta(name="cm"), data={"n": "0"}))
+
+        def bump():
+            for _ in range(100):
+                s.mutate("ConfigMap", "cm", "default",
+                         lambda cm: cm.data.__setitem__("n", str(int(cm.data["n"]) + 1)))
+
+        ts = [threading.Thread(target=bump) for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert s.get("ConfigMap", "cm").data["n"] == "400"
+
+    def test_watch_stream(self):
+        s = APIServer()
+        s.create(mk_pod("pre"))
+        w = s.watch("Pod")
+        ev = w.next(timeout=1)
+        assert ev.type == "ADDED" and ev.obj.metadata.name == "pre"
+        s.create(mk_pod("post"))
+        ev = w.next(timeout=1)
+        assert ev.type == "ADDED" and ev.obj.metadata.name == "post"
+        s.delete("Pod", "post")
+        assert w.next(timeout=1).type == "DELETED"
+        w.stop()
+        assert w.next(timeout=0.2) is None
+
+
+class TestInformers:
+    def test_cache_sync_and_lister(self):
+        s = APIServer()
+        s.create(mk_node("n1"))
+        f = SharedInformerFactory(s)
+        nodes = f.informer("Node")
+        f.start()
+        assert f.wait_for_cache_sync()
+        assert [n.metadata.name for n in nodes.list()] == ["n1"]
+        s.create(mk_node("n2"))
+        deadline = time.time() + 2
+        while time.time() < deadline and len(nodes.list()) < 2:
+            time.sleep(0.01)
+        assert nodes.get("n2") is not None
+        f.stop()
+
+    def test_event_handlers(self):
+        s = APIServer()
+        f = SharedInformerFactory(s)
+        pods = f.informer("Pod")
+        seen = []
+        pods.add_event_handler(
+            on_add=lambda o: seen.append(("add", o.metadata.name)),
+            on_delete=lambda o: seen.append(("del", o.metadata.name)),
+        )
+        f.start()
+        s.create(mk_pod("x"))
+        s.delete("Pod", "x")
+        deadline = time.time() + 2
+        while time.time() < deadline and len(seen) < 2:
+            time.sleep(0.01)
+        assert seen == [("add", "x"), ("del", "x")]
+        f.stop()
+
+
+class TestDescriptor:
+    def test_configmap_append_via_envfrom(self):
+        # The device-assignment side channel end to end (SURVEY.md §3.3).
+        s = APIServer()
+        d = Descriptor(s)
+        d.create_configmap(ConfigMap(metadata=ObjectMeta(name="game-demo"), data={}))
+        pod = mk_pod("worker", cm_refs=["game-demo", "missing-cm"])
+        d.create_pod(pod)
+        written = d.append_to_pod_configmaps(pod, {"TPU_WORKER_ID": "0"})
+        assert written == ["game-demo"]
+        assert d.get_configmap("game-demo").data["TPU_WORKER_ID"] == "0"
+
+    def test_label_node(self):
+        s = APIServer()
+        d = Descriptor(s)
+        s.create(mk_node("tpu-node"))
+        d.label_node(PatchNodeParam("tpu-node", "add", "/metadata/labels",
+                                    {"tpu.sched/slice.config": "2x2"}))
+        assert d.get_node("tpu-node").metadata.labels["tpu.sched/slice.config"] == "2x2"
+        d.label_node(PatchNodeParam("tpu-node", "remove", "/metadata/labels",
+                                    {"tpu.sched/slice.config": ""}))
+        assert "tpu.sched/slice.config" not in d.get_node("tpu-node").metadata.labels
+
+    def test_bind_and_phase(self):
+        s = APIServer()
+        d = Descriptor(s)
+        d.create_pod(mk_pod("w"))
+        d.bind_pod("w", "default", "n1")
+        d.set_pod_phase("w", "default", "Running")
+        got = d.get_pod("w")
+        assert got.spec.node_name == "n1" and got.status.phase == "Running"
+
+    def test_discovery_parity(self):
+        # FindNodesIPFromPod parity: locate registry node via '-0' pod in
+        # namespace 'registry' (reference: utils.go:59-70 w/ ns 'redis').
+        s = APIServer()
+        d = Descriptor(s)
+        s.create(mk_node("ctrl", addr="172.20.0.5"))
+        d.create_pod(mk_pod("kvstore-0", ns="registry", node="ctrl"))
+        assert find_nodes_ip_from_pod(d, "-0", "registry") == ["172.20.0.5"]

@@ -1,0 +1,96 @@
+"""Slice-topology math tests (table-driven, mirroring the reference's style —
+SURVEY.md §4 'table-driven cases')."""
+import pytest
+
+from k8s_gpu_scheduler_tpu.api.topology import (
+    SliceTopology,
+    TPUGen,
+    chip_count,
+    config_for_partitions,
+    host_coordinates,
+    hosts_needed,
+    ici_hop_distance,
+    parse_topology,
+    partitions_for,
+    slice_diameter,
+)
+
+
+@pytest.mark.parametrize(
+    "s,want",
+    [("2x4", (2, 4)), ("2x2x2", (2, 2, 2)), ("16x16", (16, 16)), ("1x1", (1, 1))],
+)
+def test_parse_topology(s, want):
+    assert parse_topology(s) == want
+    assert chip_count(want) == int.__mul__(*want[:2]) * (want[2] if len(want) == 3 else 1)
+
+
+@pytest.mark.parametrize("s", ["", "0x2", "2x-1", "axb"])
+def test_parse_topology_rejects(s):
+    with pytest.raises(ValueError):
+        parse_topology(s)
+
+
+@pytest.mark.parametrize(
+    "topo,gen,hosts",
+    [
+        ("2x4", TPUGen.V5E, 1),     # one v5e host = 8 chips
+        ("4x4", TPUGen.V5E, 4),     # v5e-16
+        ("16x16", TPUGen.V5E, 64),  # v5e-256 full pod
+        ("2x2x1", TPUGen.V5P, 1),   # one v5p host = 4 chips
+        ("2x2x4", TPUGen.V5P, 4),   # v5p-16: the BASELINE config-4 gang
+    ],
+)
+def test_hosts_needed(topo, gen, hosts):
+    assert hosts_needed(parse_topology(topo), gen) == hosts
+
+
+def test_host_coordinates_v5p16():
+    # 2x2x4 on v5p (2x2x1 boards) → host grid (1,1,4): 4 hosts along z.
+    coords = host_coordinates(parse_topology("2x2x4"), TPUGen.V5P)
+    assert coords == [(0, 0, 0), (0, 0, 1), (0, 0, 2), (0, 0, 3)]
+
+
+@pytest.mark.parametrize(
+    "a,b,dims,wrap,want",
+    [
+        ((0, 0), (1, 3), (2, 4), False, 4),
+        ((0, 0), (0, 3), (4, 4), True, 1),   # wraparound shortens the ring
+        ((0, 0, 0), (3, 0, 0), (4, 4, 4), True, 1),
+        ((0, 0, 0), (1, 1, 1), (2, 2, 2), False, 3),
+    ],
+)
+def test_ici_hop_distance(a, b, dims, wrap, want):
+    assert ici_hop_distance(a, b, dims, wrap=wrap) == want
+
+
+def test_ici_hop_distance_rank_mismatch():
+    with pytest.raises(ValueError):
+        ici_hop_distance((0, 0), (0, 0, 0), (2, 2, 2))
+
+
+def test_slice_diameter():
+    assert slice_diameter((2, 4), wrap=False) == 4
+    assert slice_diameter((4, 4, 4), wrap=True) == 6
+
+
+def test_slice_topology_v5p16():
+    st = SliceTopology.parse("tpu-v5p-slice", "2x2x4")
+    assert st.chips == 16
+    assert st.hosts == 4
+    assert st.is_multi_host
+
+
+def test_partition_table_parity():
+    # Analogue of the reference's partitions=[4,2,1] MIG table
+    # (gpu_plugins.go:52-53): every advertised partition count resolves to a
+    # concrete sub-slice topology that tiles the host board.
+    for gen in TPUGen:
+        for parts in partitions_for(gen):
+            sub = parse_topology(config_for_partitions(gen, parts))
+            assert chip_count(sub) * parts == gen.chips_per_host
+
+
+def test_config_for_partitions_rejects_unknown():
+    with pytest.raises(ValueError):
+        config_for_partitions(TPUGen.V5E, 3)
