@@ -109,6 +109,10 @@ def host_board(dims: Sequence[int], gen: TPUGen) -> Tuple[int, ...]:
         if chip_count(dims) <= 8:
             return tuple(dims)  # whole slice on one host
         return (2, 2)
+    # v4/v5p: sub-host partitions ('2x1x1', '1x1x1' — SLICE_CONFIGS) fit on
+    # one host's 2x2x1 board; anything larger tiles by whole boards.
+    if chip_count(dims) <= 4:
+        return tuple(dims)
     return gen.host_topology
 
 
@@ -118,9 +122,12 @@ def host_grid(dims: Sequence[int], gen: TPUGen) -> Tuple[int, ...]:
     grid = []
     for i, d in enumerate(dims):
         h = host[i] if i < len(host) else 1
-        if d % h and d >= h:
+        if d % h:
+            # Every axis must tile exactly by the host board — '1x16' on v5e
+            # (2x2 boards) is not a GKE topology and must be rejected, not
+            # rounded up to 8 hosts.
             raise ValueError(f"topology {dims} not host-aligned for {gen.value}")
-        grid.append(max(1, d // h))
+        grid.append(d // h)
     return tuple(grid)
 
 
@@ -160,9 +167,13 @@ class SliceTopology:
 
     @property
     def has_wraparound(self) -> bool:
-        # Full-pod rings only exist when every axis is a multiple of 4 on 3D
-        # tori (v4/v5p) — approximation good enough for scoring.
-        return self.gen.torus_dims == 3 and all(d >= 4 for d in self.dims)
+        # 3D tori (v4/v5p): sub-slices with every axis a multiple of 4 get
+        # wrapped rings (GKE grants twisted-torus wrap at cube granularity).
+        # 2D tori (v5e/v6e): only the full 16x16 pod has wrapped rings —
+        # partial slices are meshes.
+        if self.gen.torus_dims == 3:
+            return all(d >= 4 and d % 4 == 0 for d in self.dims)
+        return all(d >= 16 for d in self.dims)
 
     def diameter(self) -> int:
         return slice_diameter(self.dims, wrap=self.has_wraparound)

@@ -125,11 +125,15 @@ class APIServer:
         scheduler uses for ConfigMap appends (the reference's racy
         read-then-Update at pkg/resources/pods.go:156-175 becomes atomic)."""
         with self._mu:
-            obj = self._store.get(kind, {}).get(f"{namespace}/{name}")
-            if obj is None:
+            cur = self._store.get(kind, {}).get(f"{namespace}/{name}")
+            if cur is None:
                 raise NotFound(f"{kind} {namespace}/{name}")
+            # fn runs on a copy: a raising fn leaves the store untouched, and
+            # fn can never capture a reference into live store state.
+            obj = deepcopy_obj(cur)
             fn(obj)
             self._bump(obj)
+            self._store[kind][f"{namespace}/{name}"] = obj
             self._notify(kind, WatchEvent("MODIFIED", deepcopy_obj(obj)))
             return deepcopy_obj(obj)
 

@@ -8,10 +8,13 @@ globals (the reference's latent race, SURVEY.md §5 "Race detection").
 """
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from .apiserver import APIServer, Watch, WatchEvent
+
+log = logging.getLogger(__name__)
 
 
 class Informer:
@@ -34,6 +37,14 @@ class Informer:
         with self._mu:
             for obj in self._server.list(self.kind):
                 self._cache[obj.metadata.key] = obj
+            initial = list(self._cache.values())
+        # Synthetic ADD delivery for the initial list — client-go semantics:
+        # handlers registered before start() see every pre-existing object.
+        # (The watch replay of these same objects is then dropped as stale by
+        # _apply's resource_version check, so no double delivery. The watch
+        # thread is not running yet, so no synchronization race here.)
+        for obj in initial:
+            self._dispatch("ADDED", None, obj, list(self._handlers))
         self._synced.set()
         self._thread = threading.Thread(
             target=self._run, name=f"informer-{self.kind}", daemon=True
@@ -67,13 +78,26 @@ class Informer:
                 if old is not None and old.metadata.resource_version >= ev.obj.metadata.resource_version:
                     return
                 self._cache[key] = ev.obj
-        for h in self._handlers:
-            if ev.type == "ADDED" and "on_add" in h:
-                h["on_add"](ev.obj)
-            elif ev.type == "MODIFIED" and "on_update" in h:
-                h["on_update"](old, ev.obj)
-            elif ev.type == "DELETED" and "on_delete" in h:
-                h["on_delete"](ev.obj)
+            # Snapshot handlers under the SAME lock as the cache update: a
+            # handler registered after this point sees the object via its
+            # synthetic-add replay instead, never both (exactly-once).
+            handlers = list(self._handlers)
+        self._dispatch(ev.type, old, ev.obj, handlers)
+
+    def _dispatch(self, ev_type: str, old: Any, obj: Any, handlers: List[Dict[str, Callable[..., None]]]) -> None:
+        # Handlers run outside the cache lock (so they may observe a cache
+        # already newer than their event — same relaxation client-go makes).
+        # A raising handler must not kill the watch thread.
+        for h in handlers:
+            try:
+                if ev_type == "ADDED" and "on_add" in h:
+                    h["on_add"](obj)
+                elif ev_type == "MODIFIED" and "on_update" in h:
+                    h["on_update"](old, obj)
+                elif ev_type == "DELETED" and "on_delete" in h:
+                    h["on_delete"](obj)
+            except Exception:  # noqa: BLE001
+                log.exception("informer %s handler failed on %s", self.kind, ev_type)
 
     def add_event_handler(
         self,
@@ -81,6 +105,9 @@ class Informer:
         on_update: Optional[Callable[[Any, Any], None]] = None,
         on_delete: Optional[Callable[[Any], None]] = None,
     ) -> None:
+        """Register handlers. If the informer has already synced, ``on_add``
+        is immediately invoked for every object in the cache (client-go's
+        synthetic-add semantics for late handler registration)."""
         h: Dict[str, Callable[..., None]] = {}
         if on_add:
             h["on_add"] = on_add
@@ -88,12 +115,28 @@ class Informer:
             h["on_update"] = on_update
         if on_delete:
             h["on_delete"] = on_delete
-        self._handlers.append(h)
+        # Append + cache snapshot under one lock acquisition: _apply updates
+        # the cache and snapshots handlers under the same lock, so an object
+        # arrives either via the watch dispatch (handler already appended) or
+        # via this replay (object already cached) — never both.
+        with self._mu:
+            self._handlers.append(h)
+            replay = list(self._cache.values()) if (on_add and self._synced.is_set()) else []
+        for obj in replay:
+            try:
+                on_add(obj)
+            except Exception:  # noqa: BLE001
+                log.exception("informer %s synthetic add failed", self.kind)
 
     def has_synced(self) -> bool:
         return self._synced.is_set()
 
     # -- lister / indexer --------------------------------------------------
+    #
+    # READ-ONLY CONTRACT: list()/get() return the cached objects by
+    # reference, exactly as client-go listers do — callers MUST NOT mutate
+    # them (mutate via Descriptor/APIServer instead, which deep-copies).
+    # This keeps the hot scheduling path allocation-free.
     def list(
         self,
         namespace: Optional[str] = None,

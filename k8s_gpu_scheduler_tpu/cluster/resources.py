@@ -54,10 +54,17 @@ class Descriptor:
     def bind_pod(self, name: str, namespace: str, node_name: str) -> Pod:
         """The Bind verb: set spec.nodeName (upstream kube-scheduler does this
         through the binding subresource; the plugin never binds directly)."""
+        host_ip = node_name
+        try:
+            node = self.get_node(node_name)
+            if node.status.addresses:
+                host_ip = node.status.addresses[0]
+        except NotFound:
+            pass
 
         def fn(p: Pod) -> None:
             p.spec.node_name = node_name
-            p.status.host_ip = node_name
+            p.status.host_ip = host_ip
 
         return self.server.mutate("Pod", name, namespace, fn)
 

@@ -185,3 +185,83 @@ class TestDescriptor:
         s.create(mk_node("ctrl", addr="172.20.0.5"))
         d.create_pod(mk_pod("kvstore-0", ns="registry", node="ctrl"))
         assert find_nodes_ip_from_pod(d, "-0", "registry") == ["172.20.0.5"]
+
+
+class TestAdviceRegressions:
+    """Regression tests for the round-1 advisor findings (ADVICE.md)."""
+
+    def test_pre_registered_handler_sees_initial_list(self):
+        # ADVICE medium: handlers registered before start() must receive ADD
+        # events for objects that existed before the informer started.
+        s = APIServer()
+        s.create(mk_pod("pre-existing"))
+        f = SharedInformerFactory(s)
+        pods = f.informer("Pod")
+        seen = []
+        pods.add_event_handler(on_add=lambda o: seen.append(o.metadata.name))
+        f.start()
+        assert f.wait_for_cache_sync()
+        assert seen == ["pre-existing"]
+        # And the watch replay of the same object must not double-deliver.
+        s.create(mk_pod("later"))
+        deadline = time.time() + 2
+        while time.time() < deadline and len(seen) < 2:
+            time.sleep(0.01)
+        assert seen == ["pre-existing", "later"]
+        f.stop()
+
+    def test_late_handler_gets_synthetic_adds(self):
+        s = APIServer()
+        s.create(mk_pod("a"))
+        f = SharedInformerFactory(s)
+        pods = f.informer("Pod")
+        f.start()
+        assert f.wait_for_cache_sync()
+        seen = []
+        pods.add_event_handler(on_add=lambda o: seen.append(o.metadata.name))
+        assert seen == ["a"]
+        f.stop()
+
+    def test_raising_handler_does_not_kill_watch(self):
+        s = APIServer()
+        f = SharedInformerFactory(s)
+        pods = f.informer("Pod")
+        seen = []
+
+        def bad_handler(obj):
+            raise RuntimeError("boom")
+
+        pods.add_event_handler(on_add=bad_handler)
+        pods.add_event_handler(on_add=lambda o: seen.append(o.metadata.name))
+        f.start()
+        s.create(mk_pod("x"))
+        s.create(mk_pod("y"))
+        deadline = time.time() + 2
+        while time.time() < deadline and len(seen) < 2:
+            time.sleep(0.01)
+        assert seen == ["x", "y"]
+        f.stop()
+
+    def test_failed_mutate_leaves_store_untouched(self):
+        # ADVICE: mutate() must run fn on a copy and swap only on success.
+        s = APIServer()
+        s.create(ConfigMap(metadata=ObjectMeta(name="cm"), data={"k": "v"}))
+        rv_before = s.get("ConfigMap", "cm").metadata.resource_version
+
+        def partial_then_raise(cm):
+            cm.data["poison"] = "1"
+            raise RuntimeError("midway failure")
+
+        with pytest.raises(RuntimeError):
+            s.mutate("ConfigMap", "cm", "default", partial_then_raise)
+        got = s.get("ConfigMap", "cm")
+        assert "poison" not in got.data
+        assert got.metadata.resource_version == rv_before
+
+    def test_bind_pod_sets_real_host_ip(self):
+        s = APIServer()
+        d = Descriptor(s)
+        s.create(mk_node("n1", addr="10.1.2.3"))
+        d.create_pod(mk_pod("w"))
+        d.bind_pod("w", "default", "n1")
+        assert d.get_pod("w").status.host_ip == "10.1.2.3"

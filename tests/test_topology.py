@@ -94,3 +94,37 @@ def test_partition_table_parity():
 def test_config_for_partitions_rejects_unknown():
     with pytest.raises(ValueError):
         config_for_partitions(TPUGen.V5E, 3)
+
+
+def test_host_grid_rejects_untileable_axes():
+    # ADVICE: '1x16' cannot be tiled by v5e 2x2 multi-host boards — reject,
+    # don't round up to 8 hosts (32 chips for a 16-chip slice).
+    with pytest.raises(ValueError):
+        hosts_needed(parse_topology("1x16"), TPUGen.V5E)
+
+
+@pytest.mark.parametrize(
+    "gen,topo,want",
+    [
+        (TPUGen.V5E, "16x16", True),   # full v5e pod has wrapped rings
+        (TPUGen.V5E, "4x4", False),    # partial v5e slice is a mesh
+        (TPUGen.V5P, "4x4x4", True),   # cube-aligned v5p sub-slice wraps
+        (TPUGen.V5P, "2x2x2", False),
+        (TPUGen.V5P, "2x2x4", False),  # not every axis a multiple of 4
+    ],
+)
+def test_has_wraparound(gen, topo, want):
+    assert SliceTopology.parse(gen, topo).has_wraparound is want
+
+
+@pytest.mark.parametrize(
+    "gen,topo,hosts",
+    [
+        (TPUGen.V5P, "1x1x1", 1),  # sub-host partitions (SLICE_CONFIGS)
+        (TPUGen.V5P, "2x1x1", 1),
+        (TPUGen.V5E, "1x2", 1),
+        (TPUGen.V5E, "1x1", 1),
+    ],
+)
+def test_sub_host_partitions_are_single_host(gen, topo, hosts):
+    assert SliceTopology.parse(gen, topo).hosts == hosts
