@@ -106,14 +106,20 @@ def host_board(dims: Sequence[int], gen: TPUGen) -> Tuple[int, ...]:
     own a 2x2x1 block.
     """
     if gen in (TPUGen.V5E, TPUGen.V6E):
-        if chip_count(dims) <= 8:
-            return tuple(dims)  # whole slice on one host
+        if chip_count(dims) <= 8 and _fits_within(dims, (2, 4)):
+            return tuple(dims)  # whole slice on one host's 2x4 board
         return (2, 2)
     # v4/v5p: sub-host partitions ('2x1x1', '1x1x1' — SLICE_CONFIGS) fit on
-    # one host's 2x2x1 board; anything larger tiles by whole boards.
-    if chip_count(dims) <= 4:
+    # one host's 2x2x1 board; anything larger tiles by whole boards. A shape
+    # like 4x1x1 has a 4-long axis no board can hold, so it falls through to
+    # whole-board tiling (2 hosts) instead of being accepted as one host.
+    if chip_count(dims) <= 4 and _fits_within(dims, gen.host_topology):
         return tuple(dims)
     return gen.host_topology
+
+
+def _fits_within(dims: Sequence[int], board: Sequence[int]) -> bool:
+    return len(dims) == len(board) and all(d <= b for d, b in zip(dims, board))
 
 
 def host_grid(dims: Sequence[int], gen: TPUGen) -> Tuple[int, ...]:

@@ -128,14 +128,16 @@ class APIServer:
             cur = self._store.get(kind, {}).get(f"{namespace}/{name}")
             if cur is None:
                 raise NotFound(f"{kind} {namespace}/{name}")
-            # fn runs on a copy: a raising fn leaves the store untouched, and
-            # fn can never capture a reference into live store state.
+            # fn runs on a copy: a raising fn leaves the store untouched. The
+            # stored object is a further copy, so even a fn that retains its
+            # argument can never reach live store state afterwards.
             obj = deepcopy_obj(cur)
             fn(obj)
-            self._bump(obj)
-            self._store[kind][f"{namespace}/{name}"] = obj
-            self._notify(kind, WatchEvent("MODIFIED", deepcopy_obj(obj)))
-            return deepcopy_obj(obj)
+            stored = deepcopy_obj(obj)
+            self._bump(stored)
+            self._store[kind][f"{namespace}/{name}"] = stored
+            self._notify(kind, WatchEvent("MODIFIED", deepcopy_obj(stored)))
+            return deepcopy_obj(stored)
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         with self._mu:

@@ -26,26 +26,36 @@ class Informer:
         self._synced = threading.Event()
         self._watch: Optional[Watch] = None
         self._thread: Optional[threading.Thread] = None
+        self._started = False
         self._handlers: List[Dict[str, Callable[..., None]]] = []
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
-        if self._thread is not None:
+        """Start the watch loop. Informers are single-use (client-go
+        semantics): once stopped they cannot be restarted — build a new
+        factory instead."""
+        if self._started:
             return
+        self._started = True
         # Initial list under the same subscription guarantees no missed events.
         self._watch = self._server.watch(self.kind, send_initial=True)
         with self._mu:
             for obj in self._server.list(self.kind):
                 self._cache[obj.metadata.key] = obj
             initial = list(self._cache.values())
+            handlers = list(self._handlers)
+            # _synced set inside the same critical section as the handler
+            # snapshot: a handler registered concurrently either is in
+            # ``handlers`` (registered before, no replay — it gets the loop
+            # below) or sees _synced and replays the cache itself — never
+            # neither, never both.
+            self._synced.set()
         # Synthetic ADD delivery for the initial list — client-go semantics:
         # handlers registered before start() see every pre-existing object.
         # (The watch replay of these same objects is then dropped as stale by
-        # _apply's resource_version check, so no double delivery. The watch
-        # thread is not running yet, so no synchronization race here.)
+        # _apply's resource_version check, so no double delivery.)
         for obj in initial:
-            self._dispatch("ADDED", None, obj, list(self._handlers))
-        self._synced.set()
+            self._dispatch("ADDED", None, obj, handlers)
         self._thread = threading.Thread(
             target=self._run, name=f"informer-{self.kind}", daemon=True
         )

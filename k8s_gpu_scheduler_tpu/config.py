@@ -1,0 +1,95 @@
+"""Central configuration — replaces the reference's hardcoded constants.
+
+The reference scatters its endpoints and tuning knobs as literals: Redis
+password "1234" and NodePort 32767 (gpu_plugins.go:534,859), recommender port
+32700 (:317,344), Prometheus port 30090 (:185,272), GPU-model name substrings
+(:478,497), MIG configs (:52), MPS memory splits (:898-903), discovery
+substrings "-0"/"dcgm"/"prometheus-0"/"recommender" (:471, utils/utils.go:88).
+SURVEY.md §5 ("Config / flag system") calls this out as a weakness; here every
+knob lives in one dataclass, overridable from the environment (``TPU_SCHED_*``)
+the way the reference's recommender already reads PORT/JOB_DELAY
+(recom_server.py:30-52).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+
+def _env(name: str, default, cast=None):
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if cast is None:
+        cast = type(default) if default is not None else str
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return cast(raw)
+
+
+@dataclass
+class RegistryConfig:
+    """KV registry (the Redis analogue — NodePort 32767, password "1234" in
+    the reference; deploy/redis/redis-config.yaml)."""
+
+    host: str = "127.0.0.1"
+    port: int = 32767
+    password: Optional[str] = None
+    db: int = 0
+    # Service-discovery fallback: pod-name substring + namespace, parity with
+    # FindNodesIPFromPod("-0", "redis") (utils/utils.go:59-70).
+    discovery_substring: str = "-0"
+    discovery_namespace: str = "registry"
+
+
+@dataclass
+class MetricsConfig:
+    """Prometheus-compatible instant-query endpoint (reference port 30090,
+    gpu_plugins.go:185)."""
+
+    url: str = "http://127.0.0.1:30090"
+    query_timeout_s: float = 2.0
+
+
+@dataclass
+class RecommenderConfig:
+    """Prediction service endpoint (reference NodePort 32700,
+    gpu_plugins.go:317)."""
+
+    host: str = "127.0.0.1"
+    port: int = 32700
+    timeout_s: float = 2.0
+
+
+@dataclass
+class SchedulerConfig:
+    scheduler_name: str = "tpu-scheduler"
+    # Permit phase: how long a gang pod may wait for its peers before the
+    # whole gang is rejected (PodGroup.schedule_timeout_s overrides per-group).
+    permit_timeout_s: float = 60.0
+    # Unschedulable-pod backoff (kube-scheduler defaults).
+    backoff_initial_s: float = 1.0
+    backoff_max_s: float = 10.0
+    # Score weight for the TPU plugin (reference uses weight 10100 in
+    # deploy/scheduler.yaml:8-24 to drown out default plugins).
+    tpu_score_weight: float = 1.0
+    registry: RegistryConfig = field(default_factory=RegistryConfig)
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    recommender: RecommenderConfig = field(default_factory=RecommenderConfig)
+
+    @staticmethod
+    def from_env() -> "SchedulerConfig":
+        cfg = SchedulerConfig()
+        cfg.scheduler_name = _env("TPU_SCHED_NAME", cfg.scheduler_name)
+        cfg.permit_timeout_s = _env("TPU_SCHED_PERMIT_TIMEOUT", cfg.permit_timeout_s, float)
+        cfg.backoff_initial_s = _env("TPU_SCHED_BACKOFF_INITIAL", cfg.backoff_initial_s, float)
+        cfg.backoff_max_s = _env("TPU_SCHED_BACKOFF_MAX", cfg.backoff_max_s, float)
+        cfg.tpu_score_weight = _env("TPU_SCHED_SCORE_WEIGHT", cfg.tpu_score_weight, float)
+        cfg.registry.host = _env("TPU_SCHED_REGISTRY_HOST", cfg.registry.host)
+        cfg.registry.port = _env("TPU_SCHED_REGISTRY_PORT", cfg.registry.port, int)
+        cfg.registry.password = _env("TPU_SCHED_REGISTRY_PASSWORD", cfg.registry.password, str)
+        cfg.metrics.url = _env("TPU_SCHED_METRICS_URL", cfg.metrics.url)
+        cfg.recommender.host = _env("TPU_SCHED_RECOMMENDER_HOST", cfg.recommender.host)
+        cfg.recommender.port = _env("TPU_SCHED_RECOMMENDER_PORT", cfg.recommender.port, int)
+        return cfg

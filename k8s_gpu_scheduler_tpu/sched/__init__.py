@@ -1,0 +1,44 @@
+"""Scheduler control plane (SURVEY.md L4) — the framework the reference
+inherits from kube-scheduler, implemented natively: queue, cache with TPU
+chip accounting, plugin extension points, and the scheduling/binding cycle."""
+from .cache import Cache, NodeInfo
+from .framework import (
+    CycleState,
+    FilterPlugin,
+    Handle,
+    MAX_NODE_SCORE,
+    MIN_NODE_SCORE,
+    PermitPlugin,
+    Plugin,
+    PostBindPlugin,
+    PreFilterPlugin,
+    Profile,
+    ReservePlugin,
+    ScorePlugin,
+    Status,
+    WaitingPod,
+)
+from .queue import SchedulingQueue, pod_priority
+from .scheduler import Scheduler
+
+__all__ = [
+    "Cache",
+    "NodeInfo",
+    "CycleState",
+    "FilterPlugin",
+    "Handle",
+    "MAX_NODE_SCORE",
+    "MIN_NODE_SCORE",
+    "PermitPlugin",
+    "Plugin",
+    "PostBindPlugin",
+    "PreFilterPlugin",
+    "Profile",
+    "ReservePlugin",
+    "ScorePlugin",
+    "Status",
+    "WaitingPod",
+    "SchedulingQueue",
+    "pod_priority",
+    "Scheduler",
+]

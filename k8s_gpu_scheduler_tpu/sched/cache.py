@@ -1,0 +1,188 @@
+"""Cluster cache with TPU chip accounting — the scheduler's world model.
+
+Upstream kube-scheduler keeps a cache of NodeInfos plus "assumed" pods
+(reserved but not yet observed bound through the watch); the reference
+inherits that wholesale (SURVEY.md §3.1 — "queues, cache, Filter/Score cycle
+... inherited, not implemented"). We implement it: per-node chip accounting
+(allocatable − Σ requests of bound+assumed pods) is the predicate VERDICT.md
+weak-item 7 flagged as missing — a TPU Filter cannot exist without it.
+
+Chips are the ``google.com/tpu`` extended resource (objects.py:26); slice
+shape/generation ride on the GKE node labels.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api.objects import Node, Pod, TPU_RESOURCE
+from ..api.topology import SliceTopology, TPUGen
+
+
+@dataclass
+class NodeInfo:
+    """Point-in-time view of one node. Snapshots hand these out by value —
+    plugins may read freely; mutation happens only inside the Cache."""
+
+    node: Node
+    pods: List[Pod] = field(default_factory=list)
+    requested_tpu: int = 0
+
+    @property
+    def name(self) -> str:
+        return self.node.metadata.name
+
+    @property
+    def allocatable_tpu(self) -> int:
+        return int(self.node.status.allocatable.get(TPU_RESOURCE, 0))
+
+    @property
+    def free_tpu(self) -> int:
+        return self.allocatable_tpu - self.requested_tpu
+
+    def slice_topology(self) -> Optional[SliceTopology]:
+        acc, topo = self.node.tpu_accelerator(), self.node.tpu_topology()
+        if not acc or not topo:
+            return None
+        try:
+            return SliceTopology.parse(TPUGen(acc), topo)
+        except ValueError:
+            return None
+
+    def shallow_copy(self) -> "NodeInfo":
+        return NodeInfo(node=self.node, pods=list(self.pods), requested_tpu=self.requested_tpu)
+
+
+class Cache:
+    """Thread-safe node/pod cache with assume semantics.
+
+    Lifecycle of a pod through the cache (kube-scheduler's state machine):
+      assume(pod, node)      — Reserve succeeded; chips debited immediately so
+                               the next cycle's snapshot sees them taken.
+      finish_binding(pod)    — bind API call succeeded; the assumed entry now
+                               waits for the watch to confirm.
+      forget(pod)            — Reserve/Permit/bind failed; chips credited back.
+      add/update/delete_pod  — watch events; a confirmed add replaces the
+                               assumed entry.
+    """
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._nodes: Dict[str, NodeInfo] = {}
+        # uid -> (pod, node_name) reserved in-flight
+        self._assumed: Dict[str, tuple] = {}
+
+    # -- node events -------------------------------------------------------
+    def add_node(self, node: Node) -> None:
+        with self._mu:
+            info = self._nodes.get(node.metadata.name)
+            if info is None:
+                self._nodes[node.metadata.name] = NodeInfo(node=node)
+            else:
+                info.node = node
+
+    def update_node(self, _old: Optional[Node], new: Node) -> None:
+        self.add_node(new)
+
+    def delete_node(self, node: Node) -> None:
+        with self._mu:
+            self._nodes.pop(node.metadata.name, None)
+
+    # -- pod events (from the watch) --------------------------------------
+    def add_pod(self, pod: Pod) -> None:
+        if not pod.spec.node_name:
+            return
+        with self._mu:
+            uid = pod.metadata.uid
+            assumed = self._assumed.pop(uid, None)
+            if assumed is not None:
+                a_pod, a_node = assumed
+                if a_node != pod.spec.node_name:
+                    # bound somewhere else than assumed — credit the debit
+                    self._debit(a_node, -a_pod.spec.tpu_chips(), a_pod, remove=True)
+                else:
+                    # already debited by assume; just swap the pod object in
+                    self._replace_pod(a_node, pod)
+                    return
+            self._debit(pod.spec.node_name, pod.spec.tpu_chips(), pod)
+
+    def update_pod(self, old: Optional[Pod], new: Pod) -> None:
+        if old is not None and old.spec.node_name and old.spec.node_name != new.spec.node_name:
+            self.delete_pod(old)
+        if not (old is not None and old.spec.node_name == new.spec.node_name):
+            self.add_pod(new)
+            return
+        with self._mu:
+            self._replace_pod(new.spec.node_name, new)
+
+    def delete_pod(self, pod: Pod) -> None:
+        if not pod.spec.node_name:
+            return
+        with self._mu:
+            self._debit(pod.spec.node_name, -pod.spec.tpu_chips(), pod, remove=True)
+
+    # -- assume / forget ---------------------------------------------------
+    def assume(self, pod: Pod, node_name: str) -> None:
+        with self._mu:
+            self._assumed[pod.metadata.uid] = (pod, node_name)
+            self._debit(node_name, pod.spec.tpu_chips(), pod)
+
+    def finish_binding(self, pod: Pod) -> None:
+        # No-op beyond bookkeeping: the assumed entry is reconciled when the
+        # watch delivers the bound pod (add_pod above).
+        pass
+
+    def forget(self, pod: Pod) -> None:
+        with self._mu:
+            assumed = self._assumed.pop(pod.metadata.uid, None)
+            if assumed is None:
+                return
+            a_pod, a_node = assumed
+            self._debit(a_node, -a_pod.spec.tpu_chips(), a_pod, remove=True)
+
+    def is_assumed(self, pod: Pod) -> bool:
+        with self._mu:
+            return pod.metadata.uid in self._assumed
+
+    # -- snapshot ----------------------------------------------------------
+    def snapshot(self) -> Dict[str, NodeInfo]:
+        """Copy-on-read view for one scheduling cycle (kube-scheduler's
+        Snapshot().NodeInfos(), used by the reference at gpu_plugins.go:798)."""
+        with self._mu:
+            return {name: info.shallow_copy() for name, info in self._nodes.items()}
+
+    def node_names(self) -> List[str]:
+        with self._mu:
+            return list(self._nodes)
+
+    # -- internals (call with lock held) ----------------------------------
+    def _debit(self, node_name: str, chips: int, pod: Pod, remove: bool = False) -> None:
+        info = self._nodes.get(node_name)
+        if info is None:
+            # Node not (yet) known — create a placeholder so accounting
+            # survives pod-before-node event ordering.
+            info = NodeInfo(node=Node.__new__(Node))
+            from ..api.objects import NodeStatus, ObjectMeta  # local to avoid cycle
+
+            info.node = Node(metadata=ObjectMeta(name=node_name))
+            self._nodes[node_name] = info
+        info.requested_tpu += chips
+        if remove:
+            info.pods = [p for p in info.pods if p.metadata.uid != pod.metadata.uid]
+        else:
+            self._replace_pod_in(info, pod)
+
+    def _replace_pod(self, node_name: str, pod: Pod) -> None:
+        info = self._nodes.get(node_name)
+        if info is not None:
+            self._replace_pod_in(info, pod)
+
+    @staticmethod
+    def _replace_pod_in(info: NodeInfo, pod: Pod) -> None:
+        for i, p in enumerate(info.pods):
+            if p.metadata.uid == pod.metadata.uid:
+                info.pods[i] = pod
+                return
+        info.pods.append(pod)

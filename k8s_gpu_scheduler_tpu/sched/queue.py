@@ -1,0 +1,145 @@
+"""Scheduling queue: active heap + unschedulable backoff.
+
+Upstream kube-scheduler's PriorityQueue (active / backoff / unschedulable
+pools with event-driven moves); the reference inherits it unmodified
+(SURVEY.md §3.1). Ours keeps the same three-pool design:
+
+- active: heap ordered by (−priority, creation time) — FIFO within equal
+  priority (priority from the ``tpu.sched/priority`` annotation).
+- backoff: unschedulable pods re-enter active after exponential backoff.
+- cluster events (node add/update, pod delete) flush backoff early via
+  ``move_all_to_active`` so capacity freed now is used now.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..api.objects import Pod
+
+ANN_PRIORITY = "tpu.sched/priority"
+
+
+def pod_priority(pod: Pod) -> int:
+    try:
+        return int(pod.metadata.annotations.get(ANN_PRIORITY, "0"))
+    except ValueError:
+        return 0
+
+
+class SchedulingQueue:
+    def __init__(self, backoff_initial_s: float = 1.0, backoff_max_s: float = 10.0) -> None:
+        self._mu = threading.Condition()
+        self._heap: List[Tuple[int, float, int, Pod]] = []
+        self._queued_uids: Dict[str, int] = {}  # uid -> attempt count
+        self._backoff: Dict[str, Tuple[float, Pod]] = {}  # uid -> (ready_at, pod)
+        self._seq = itertools.count()
+        self._backoff_initial = backoff_initial_s
+        self._backoff_max = backoff_max_s
+        self._closed = False
+
+    # -- producers ---------------------------------------------------------
+    def add(self, pod: Pod) -> None:
+        """New pending pod (informer on_add)."""
+        with self._mu:
+            if pod.metadata.uid in self._queued_uids or pod.metadata.uid in self._backoff:
+                return
+            self._queued_uids[pod.metadata.uid] = 0
+            self._push(pod)
+            self._mu.notify()
+
+    def add_unschedulable(self, pod: Pod) -> None:
+        """Failed cycle → backoff pool with exponential delay."""
+        with self._mu:
+            attempts = self._queued_uids.get(pod.metadata.uid, 0) + 1
+            self._queued_uids[pod.metadata.uid] = attempts
+            delay = min(self._backoff_initial * (2 ** (attempts - 1)), self._backoff_max)
+            self._backoff[pod.metadata.uid] = (time.monotonic() + delay, pod)
+            self._mu.notify()
+
+    def requeue(self, pod: Pod) -> None:
+        """Immediate retry (transient error, not an unschedulable verdict)."""
+        with self._mu:
+            if pod.metadata.uid in self._backoff:
+                return
+            self._queued_uids.setdefault(pod.metadata.uid, 0)
+            self._push(pod)
+            self._mu.notify()
+
+    def remove(self, pod: Pod) -> None:
+        """Pod deleted while queued."""
+        with self._mu:
+            self._queued_uids.pop(pod.metadata.uid, None)
+            self._backoff.pop(pod.metadata.uid, None)
+            # lazily dropped from the heap at pop time
+
+    def move_all_to_active(self, _reason: str = "") -> None:
+        """Cluster changed — give every backed-off pod another chance now
+        (kube-scheduler's MoveAllToActiveOrBackoffQueue)."""
+        with self._mu:
+            for uid, (_ready, pod) in list(self._backoff.items()):
+                del self._backoff[uid]
+                self._push(pod)
+            self._mu.notify_all()
+
+    def done(self, pod: Pod) -> None:
+        """Pod left the scheduling pipeline (bound or abandoned)."""
+        with self._mu:
+            self._queued_uids.pop(pod.metadata.uid, None)
+            self._backoff.pop(pod.metadata.uid, None)
+
+    def close(self) -> None:
+        with self._mu:
+            self._closed = True
+            self._mu.notify_all()
+
+    # -- consumer ----------------------------------------------------------
+    def pop(self, timeout: Optional[float] = None) -> Optional[Pod]:
+        """Next pod to schedule, honoring backoff readiness; None on timeout
+        or close."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._mu:
+            while True:
+                if self._closed:
+                    return None
+                self._promote_ready()
+                while self._heap:
+                    _, _, _, pod = heapq.heappop(self._heap)
+                    if pod.metadata.uid in self._queued_uids and pod.metadata.uid not in self._backoff:
+                        return pod
+                    # stale entry (removed or re-backed-off) — skip
+                wait = self._next_wait(deadline)
+                if wait is not None and wait <= 0:
+                    return None
+                self._mu.wait(timeout=wait)
+
+    def pending_count(self) -> int:
+        with self._mu:
+            return len(self._queued_uids)
+
+    # -- internals (lock held) --------------------------------------------
+    def _push(self, pod: Pod) -> None:
+        heapq.heappush(
+            self._heap,
+            (-pod_priority(pod), pod.metadata.creation_timestamp, next(self._seq), pod),
+        )
+
+    def _promote_ready(self) -> None:
+        now = time.monotonic()
+        for uid, (ready_at, pod) in list(self._backoff.items()):
+            if ready_at <= now:
+                del self._backoff[uid]
+                self._push(pod)
+
+    def _next_wait(self, deadline: Optional[float]) -> Optional[float]:
+        """Seconds to sleep before something can happen; None = forever."""
+        candidates = []
+        if deadline is not None:
+            candidates.append(deadline - time.monotonic())
+        if self._backoff:
+            soonest = min(ready for ready, _ in self._backoff.values())
+            candidates.append(soonest - time.monotonic())
+        return min(candidates) if candidates else None

@@ -1,0 +1,272 @@
+"""The scheduling cycle — what the reference inherits from kube-scheduler.
+
+The reference's binary is upstream kube-scheduler with one plugin compiled in
+(cmd/scheduler/main.go:20-22); queues, cache, the Filter/Score loop, binding
+and the Permit machinery all come from k8s.io/kubernetes v1.21 (SURVEY.md
+§3.1). This module is our implementation of that inherited core:
+
+  pop → snapshot → PreFilter → Filter×nodes → Score×nodes → NormalizeScore →
+  select → assume → Reserve → Permit (may WAIT) → bind → PostBind
+
+with kube-scheduler's error contract: any failure after assume runs every
+Reserve plugin's unreserve, forgets the assumed pod, and requeues with
+backoff. Binding runs on a binder pool so a gang pod WAITing in Permit never
+blocks the next pod's scheduling cycle (that concurrency is exactly what
+gang admission needs).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from ..api.objects import Pod
+from ..cluster.apiserver import APIServer
+from ..cluster.informers import SharedInformerFactory
+from ..cluster.resources import Descriptor
+from ..config import SchedulerConfig
+from .cache import Cache, NodeInfo
+from .framework import (
+    CycleState,
+    Handle,
+    Profile,
+    Status,
+    SUCCESS,
+    UNSCHEDULABLE,
+    WAIT,
+    WaitingPod,
+)
+from .queue import SchedulingQueue
+
+log = logging.getLogger(__name__)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        server: APIServer,
+        profile: Optional[Profile] = None,
+        config: Optional[SchedulerConfig] = None,
+    ) -> None:
+        self.config = config or SchedulerConfig()
+        self.server = server
+        self.descriptor = Descriptor(server)
+        self.factory = SharedInformerFactory(server)
+        self.cache = Cache()
+        self.queue = SchedulingQueue(
+            backoff_initial_s=self.config.backoff_initial_s,
+            backoff_max_s=self.config.backoff_max_s,
+        )
+        self.profile = profile or Profile()
+        self.handle = Handle(self.factory, self.descriptor, self.cache, self.config)
+        # Why the last cycle for a pod failed — introspection + tests.
+        self.failure_reasons: Dict[str, str] = {}
+        self._fail_mu = threading.Lock()
+        self._binder = ThreadPoolExecutor(max_workers=16, thread_name_prefix="binder")
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._wire_informers()
+
+    # -- informer wiring ---------------------------------------------------
+    def _wire_informers(self) -> None:
+        nodes = self.factory.informer("Node")
+        pods = self.factory.informer("Pod")
+        nodes.add_event_handler(
+            on_add=lambda n: (self.cache.add_node(n), self.queue.move_all_to_active("node-add")),
+            on_update=lambda old, new: (
+                self.cache.update_node(old, new),
+                self.queue.move_all_to_active("node-update"),
+            ),
+            on_delete=self.cache.delete_node,
+        )
+        pods.add_event_handler(
+            on_add=self._on_pod_add, on_update=self._on_pod_update, on_delete=self._on_pod_delete
+        )
+
+    def _ours(self, pod: Pod) -> bool:
+        return pod.spec.scheduler_name == self.config.scheduler_name
+
+    def _on_pod_add(self, pod: Pod) -> None:
+        if pod.spec.node_name:
+            self.cache.add_pod(pod)
+        elif self._ours(pod) and pod.status.phase == "Pending":
+            self.queue.add(pod)
+
+    def _on_pod_update(self, old: Optional[Pod], new: Pod) -> None:
+        if new.spec.node_name:
+            self.cache.update_pod(old, new)
+            if new.status.phase in ("Succeeded", "Failed"):
+                # Terminal pods release their chips.
+                self.cache.delete_pod(new)
+                self.queue.move_all_to_active("pod-finished")
+        elif self._ours(new) and new.status.phase == "Pending":
+            self.queue.add(new)
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        if pod.spec.node_name:
+            self.cache.delete_pod(pod)
+            self.queue.move_all_to_active("pod-deleted")
+        else:
+            self.queue.remove(pod)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.factory.informer("Node")
+        self.factory.informer("Pod")
+        self.factory.start()
+        self.factory.wait_for_cache_sync()
+        self._thread = threading.Thread(target=self._run, name="sched-cycle", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._binder.shutdown(wait=True)
+        self.factory.stop()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            pod = self.queue.pop(timeout=0.5)
+            if pod is None:
+                continue
+            try:
+                self.schedule_pod(pod)
+            except Exception:  # noqa: BLE001 — the cycle must survive anything
+                log.exception("scheduling cycle failed for %s", pod.metadata.key)
+                self.queue.add_unschedulable(pod)
+
+    # -- one cycle ---------------------------------------------------------
+    def schedule_pod(self, pod: Pod) -> None:
+        # Revalidate against the live informer: the queued object may be
+        # stale (deleted or already bound while queued).
+        live = self.factory.informer("Pod").get(pod.metadata.name, pod.metadata.namespace)
+        if live is None or live.spec.node_name:
+            self.queue.done(pod)
+            return
+        pod = live
+
+        state = CycleState()
+        for pl in self.profile.pre_filter:
+            st = pl.pre_filter(state, pod)
+            if st.code == UNSCHEDULABLE:
+                self._record_failure(pod, f"{pl.name}: {st.message}")
+                self.queue.add_unschedulable(pod)
+                return
+            if not st.ok:
+                self.queue.add_unschedulable(pod)
+                return
+
+        snapshot = self.cache.snapshot()
+        feasible: List[NodeInfo] = []
+        reasons: Dict[str, str] = {}
+        for info in snapshot.values():
+            verdict = None
+            for pl in self.profile.filter:
+                st = pl.filter(state, pod, info)
+                if not st.ok:
+                    verdict = f"{pl.name}: {st.message}"
+                    break
+            if verdict is None:
+                feasible.append(info)
+            else:
+                reasons[info.name] = verdict
+
+        if not feasible:
+            msg = "; ".join(f"{n}: {r}" for n, r in sorted(reasons.items())) or "no nodes"
+            self._record_failure(pod, f"0/{len(snapshot)} nodes available: {msg}")
+            self.queue.add_unschedulable(pod)
+            return
+
+        best = self._select_node(state, pod, feasible)
+
+        # Reserve: debit the cache first so concurrent cycles see the chips
+        # taken, then run Reserve plugins (scheduler-local state only).
+        self.cache.assume(pod, best)
+        for pl in self.profile.reserve:
+            st = pl.reserve(state, pod, best)
+            if not st.ok:
+                self._record_failure(pod, f"{pl.name}: {st.message}")
+                self._abort_after_assume(state, pod, best)
+                return
+
+        # Permit: may park the pod (gang admission).
+        wait_plugins: List[str] = []
+        wait_timeout = self.config.permit_timeout_s
+        for pl in self.profile.permit:
+            st, timeout = pl.permit(state, pod, best)
+            if st.code == WAIT:
+                wait_plugins.append(pl.name)
+                wait_timeout = min(wait_timeout, timeout) if timeout > 0 else wait_timeout
+            elif not st.ok:
+                self._record_failure(pod, f"{pl.name}: {st.message}")
+                self._abort_after_assume(state, pod, best)
+                return
+
+        if wait_plugins:
+            wp = WaitingPod(pod, best, wait_plugins)
+            self.handle.add_waiting_pod(wp)
+            self._binder.submit(self._wait_then_bind, state, wp, wait_timeout)
+        else:
+            self._binder.submit(self._bind, state, pod, best)
+
+    def _select_node(self, state: CycleState, pod: Pod, feasible: List[NodeInfo]) -> str:
+        if len(feasible) == 1 or not self.profile.score:
+            return sorted(info.name for info in feasible)[0]
+        totals: Dict[str, float] = {info.name: 0.0 for info in feasible}
+        for pl in self.profile.score:
+            scores: Dict[str, float] = {}
+            for info in feasible:
+                val, st = pl.score(state, pod, info.name)
+                scores[info.name] = val if st.ok else 0.0
+            pl.normalize_scores(state, pod, scores)
+            for name, val in scores.items():
+                totals[name] += pl.weight * val
+        # Deterministic tie-break by name (upstream randomizes; determinism
+        # makes hermetic tests exact).
+        return max(sorted(totals), key=lambda n: totals[n])
+
+    # -- binding (async) ---------------------------------------------------
+    def _wait_then_bind(self, state: CycleState, wp: WaitingPod, timeout: float) -> None:
+        st = wp.wait(timeout)
+        self.handle.remove_waiting_pod(wp.uid)
+        if not st.ok:
+            self._record_failure(wp.pod, st.message)
+            self._abort_after_assume(state, wp.pod, wp.node_name)
+            return
+        self._bind(state, wp.pod, wp.node_name)
+
+    def _bind(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        try:
+            self.descriptor.bind_pod(pod.metadata.name, pod.metadata.namespace, node_name)
+        except Exception as e:  # noqa: BLE001
+            self._record_failure(pod, f"bind failed: {e}")
+            self._abort_after_assume(state, pod, node_name)
+            return
+        self.cache.finish_binding(pod)
+        self.queue.done(pod)
+        with self._fail_mu:
+            self.failure_reasons.pop(pod.metadata.key, None)
+        for pl in self.profile.post_bind:
+            try:
+                pl.post_bind(state, pod, node_name)
+            except Exception:  # noqa: BLE001
+                log.exception("post_bind %s failed for %s", pl.name, pod.metadata.key)
+
+    # -- failure path ------------------------------------------------------
+    def _abort_after_assume(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        for pl in self.profile.reserve:
+            try:
+                pl.unreserve(state, pod, node_name)
+            except Exception:  # noqa: BLE001
+                log.exception("unreserve %s failed", pl.name)
+        self.cache.forget(pod)
+        self.queue.add_unschedulable(pod)
+
+    def _record_failure(self, pod: Pod, reason: str) -> None:
+        with self._fail_mu:
+            self.failure_reasons[pod.metadata.key] = reason
+        log.info("cannot schedule %s: %s", pod.metadata.key, reason)

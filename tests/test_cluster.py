@@ -265,3 +265,29 @@ class TestAdviceRegressions:
         d.create_pod(mk_pod("w"))
         d.bind_pod("w", "default", "n1")
         assert d.get_pod("w").status.host_ip == "10.1.2.3"
+
+    def test_mutate_fn_cannot_retain_live_reference(self):
+        s = APIServer()
+        s.create(ConfigMap(metadata=ObjectMeta(name="cm"), data={}))
+        captured = []
+        s.mutate("ConfigMap", "cm", "default", lambda cm: captured.append(cm))
+        rv = s.get("ConfigMap", "cm").metadata.resource_version
+        captured[0].data["poison"] = "1"  # mutating the retained ref
+        got = s.get("ConfigMap", "cm")
+        assert "poison" not in got.data
+        assert got.metadata.resource_version == rv
+
+    def test_informer_restart_is_noop(self):
+        # Informers are single-use: a second start() must not re-deliver
+        # synthetic ADDs for cached objects.
+        s = APIServer()
+        s.create(mk_pod("p"))
+        f = SharedInformerFactory(s)
+        pods = f.informer("Pod")
+        seen = []
+        pods.add_event_handler(on_add=lambda o: seen.append(o.metadata.name))
+        f.start()
+        assert f.wait_for_cache_sync()
+        f.stop()
+        pods.start()
+        assert seen == ["p"]

@@ -1,0 +1,443 @@
+"""Scheduler framework tests — queue, cache accounting, and the full cycle.
+
+The reference's scheduling framework comes from upstream kube-scheduler and
+is completely untested in its repo (SURVEY.md §4: "zero tests for the
+scheduler plugin itself"); these are the hermetic scheduler tests the rebuild
+owes (SURVEY.md hard part d).
+"""
+import threading
+import time
+
+import pytest
+
+from k8s_gpu_scheduler_tpu.api.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+    TPU_RESOURCE,
+    LABEL_TPU_ACCELERATOR,
+    LABEL_TPU_TOPOLOGY,
+)
+from k8s_gpu_scheduler_tpu.cluster import APIServer, Descriptor
+from k8s_gpu_scheduler_tpu.config import SchedulerConfig
+from k8s_gpu_scheduler_tpu.sched import (
+    Cache,
+    CycleState,
+    FilterPlugin,
+    PermitPlugin,
+    PostBindPlugin,
+    Profile,
+    ReservePlugin,
+    Scheduler,
+    SchedulingQueue,
+    ScorePlugin,
+    Status,
+)
+
+
+def mk_node(name, chips=8, gen="tpu-v5-lite-podslice", topo="2x4"):
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={LABEL_TPU_ACCELERATOR: gen, LABEL_TPU_TOPOLOGY: topo},
+        ),
+        status=NodeStatus(
+            capacity={TPU_RESOURCE: chips},
+            allocatable={TPU_RESOURCE: chips},
+            addresses=[f"10.0.0.{abs(hash(name)) % 250}"],
+        ),
+    )
+
+
+def mk_pod(name, chips=1, priority=None, ns="default"):
+    ann = {"tpu.sched/priority": str(priority)} if priority is not None else {}
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, annotations=ann),
+        spec=PodSpec(
+            containers=[
+                Container(resources=ResourceRequirements(requests={TPU_RESOURCE: chips}))
+            ]
+        ),
+    )
+
+
+def wait_until(fn, timeout=5.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# --- building-block plugins used across tests --------------------------------
+
+
+class FitFilter(FilterPlugin):
+    """Minimal chip-fit predicate (free chips >= requested)."""
+
+    name = "FitFilter"
+
+    def filter(self, state, pod, node_info):
+        need = pod.spec.tpu_chips()
+        if node_info.free_tpu >= need:
+            return Status.success()
+        return Status.unschedulable(
+            f"insufficient google.com/tpu: need {need}, free {node_info.free_tpu}"
+        )
+
+
+class MostFreeScore(ScorePlugin):
+    name = "MostFreeScore"
+
+    def __init__(self, cache):
+        self._cache = cache
+
+    def score(self, state, pod, node_name):
+        info = self._cache.snapshot()[node_name]
+        return float(info.free_tpu), Status.success()
+
+
+# --- queue --------------------------------------------------------------------
+
+
+class TestSchedulingQueue:
+    def test_fifo_within_priority(self):
+        q = SchedulingQueue()
+        a, b = mk_pod("a"), mk_pod("b")
+        a.metadata.creation_timestamp = 1.0
+        b.metadata.creation_timestamp = 2.0
+        q.add(a)
+        q.add(b)
+        assert q.pop(0.1).metadata.name == "a"
+        assert q.pop(0.1).metadata.name == "b"
+
+    def test_priority_order(self):
+        q = SchedulingQueue()
+        lo, hi = mk_pod("lo", priority=0), mk_pod("hi", priority=10)
+        lo.metadata.creation_timestamp = 1.0
+        hi.metadata.creation_timestamp = 2.0
+        q.add(lo)
+        q.add(hi)
+        assert q.pop(0.1).metadata.name == "hi"
+
+    def test_backoff_then_ready(self):
+        q = SchedulingQueue(backoff_initial_s=0.05, backoff_max_s=0.2)
+        p = mk_pod("p")
+        q.add(p)
+        assert q.pop(0.1) is not None
+        q.add_unschedulable(p)
+        assert q.pop(0.01) is None  # still backing off
+        assert q.pop(1.0).metadata.name == "p"  # becomes ready
+
+    def test_move_all_to_active_flushes_backoff(self):
+        q = SchedulingQueue(backoff_initial_s=30.0, backoff_max_s=60.0)
+        p = mk_pod("p")
+        q.add(p)
+        q.pop(0.1)
+        q.add_unschedulable(p)
+        q.move_all_to_active("node-added")
+        assert q.pop(0.1).metadata.name == "p"
+
+    def test_remove_while_queued(self):
+        q = SchedulingQueue()
+        p = mk_pod("p")
+        q.add(p)
+        q.remove(p)
+        assert q.pop(0.05) is None
+
+    def test_pop_blocks_until_add(self):
+        q = SchedulingQueue()
+        got = []
+        t = threading.Thread(target=lambda: got.append(q.pop(2.0)))
+        t.start()
+        time.sleep(0.05)
+        q.add(mk_pod("late"))
+        t.join()
+        assert got[0].metadata.name == "late"
+
+
+# --- cache --------------------------------------------------------------------
+
+
+class TestCache:
+    def test_chip_accounting(self):
+        c = Cache()
+        c.add_node(mk_node("n1", chips=8))
+        p = mk_pod("p", chips=3)
+        p.spec.node_name = "n1"
+        c.add_pod(p)
+        info = c.snapshot()["n1"]
+        assert info.allocatable_tpu == 8 and info.requested_tpu == 3 and info.free_tpu == 5
+
+    def test_assume_then_confirm(self):
+        c = Cache()
+        c.add_node(mk_node("n1", chips=8))
+        p = mk_pod("p", chips=4)
+        c.assume(p, "n1")
+        assert c.snapshot()["n1"].free_tpu == 4
+        bound = mk_pod("p", chips=4)
+        bound.metadata.uid = p.metadata.uid
+        bound.spec.node_name = "n1"
+        c.add_pod(bound)  # watch confirms — no double debit
+        assert c.snapshot()["n1"].free_tpu == 4
+
+    def test_assume_then_forget(self):
+        c = Cache()
+        c.add_node(mk_node("n1", chips=8))
+        p = mk_pod("p", chips=4)
+        c.assume(p, "n1")
+        c.forget(p)
+        assert c.snapshot()["n1"].free_tpu == 8
+
+    def test_delete_pod_credits_back(self):
+        c = Cache()
+        c.add_node(mk_node("n1", chips=8))
+        p = mk_pod("p", chips=2)
+        p.spec.node_name = "n1"
+        c.add_pod(p)
+        c.delete_pod(p)
+        assert c.snapshot()["n1"].free_tpu == 8
+
+    def test_pod_before_node_ordering(self):
+        c = Cache()
+        p = mk_pod("p", chips=2)
+        p.spec.node_name = "n1"
+        c.add_pod(p)  # node not yet known
+        c.add_node(mk_node("n1", chips=8))
+        assert c.snapshot()["n1"].free_tpu == 6
+
+    def test_slice_topology_from_labels(self):
+        c = Cache()
+        c.add_node(mk_node("n1", gen="tpu-v5p-slice", topo="2x2x1", chips=4))
+        st = c.snapshot()["n1"].slice_topology()
+        assert st is not None and st.chips == 4 and st.hosts == 1
+
+
+# --- full cycle ---------------------------------------------------------------
+
+
+def make_scheduler(server, extra_profile=None, config=None):
+    config = config or SchedulerConfig(backoff_initial_s=0.05, backoff_max_s=0.2)
+    sched = Scheduler(server, profile=Profile(), config=config)
+    profile = extra_profile(sched) if callable(extra_profile) else Profile(
+        filter=[FitFilter()], score=[MostFreeScore(sched.cache)]
+    )
+    sched.profile = profile
+    return sched
+
+
+class TestSchedulerCycle:
+    def test_binds_all_schedulable_leaves_rest_pending(self):
+        # VERDICT.md next-round item 1's acceptance test: N nodes + M pods,
+        # daemon binds every schedulable pod, unschedulable ones stay Pending.
+        server = APIServer()
+        d = Descriptor(server)
+        for i in range(3):
+            server.create(mk_node(f"n{i}", chips=8))
+        sched = make_scheduler(server)
+        sched.start()
+        try:
+            for i in range(6):
+                d.create_pod(mk_pod(f"fit-{i}", chips=4))  # 24 chips = capacity
+            d.create_pod(mk_pod("too-big", chips=16))  # can never fit
+            assert wait_until(
+                lambda: all(
+                    d.get_pod(f"fit-{i}").spec.node_name for i in range(6)
+                )
+            )
+            # chips: each node got exactly 2 × 4-chip pods
+            by_node = {}
+            for i in range(6):
+                by_node.setdefault(d.get_pod(f"fit-{i}").spec.node_name, 0)
+                by_node[d.get_pod(f"fit-{i}").spec.node_name] += 4
+            assert all(v == 8 for v in by_node.values())
+            time.sleep(0.2)
+            big = d.get_pod("too-big")
+            assert big.spec.node_name == "" and big.status.phase == "Pending"
+            assert "insufficient google.com/tpu" in sched.failure_reasons["default/too-big"]
+        finally:
+            sched.stop()
+
+    def test_scores_pick_emptiest_node(self):
+        server = APIServer()
+        d = Descriptor(server)
+        server.create(mk_node("busy", chips=8))
+        server.create(mk_node("empty", chips=8))
+        # Pre-bound pod occupies 6 chips on 'busy'.
+        squatter = mk_pod("squatter", chips=6)
+        squatter.spec.node_name = "busy"
+        d.create_pod(squatter)
+        sched = make_scheduler(server)
+        sched.start()
+        try:
+            d.create_pod(mk_pod("new", chips=1))
+            assert wait_until(lambda: d.get_pod("new").spec.node_name != "")
+            assert d.get_pod("new").spec.node_name == "empty"
+        finally:
+            sched.stop()
+
+    def test_pod_created_before_start_is_scheduled(self):
+        server = APIServer()
+        d = Descriptor(server)
+        server.create(mk_node("n1"))
+        d.create_pod(mk_pod("early", chips=1))
+        sched = make_scheduler(server)
+        sched.start()
+        try:
+            assert wait_until(lambda: d.get_pod("early").spec.node_name == "n1")
+        finally:
+            sched.stop()
+
+    def test_capacity_freed_reschedules_pending(self):
+        server = APIServer()
+        d = Descriptor(server)
+        server.create(mk_node("n1", chips=8))
+        sched = make_scheduler(server)
+        sched.start()
+        try:
+            d.create_pod(mk_pod("first", chips=8))
+            assert wait_until(lambda: d.get_pod("first").spec.node_name == "n1")
+            d.create_pod(mk_pod("second", chips=8))
+            time.sleep(0.2)
+            assert d.get_pod("second").spec.node_name == ""
+            d.delete_pod("first")
+            assert wait_until(lambda: d.get_pod("second").spec.node_name == "n1")
+        finally:
+            sched.stop()
+
+    def test_foreign_scheduler_pods_ignored(self):
+        server = APIServer()
+        d = Descriptor(server)
+        server.create(mk_node("n1"))
+        sched = make_scheduler(server)
+        sched.start()
+        try:
+            foreign = mk_pod("foreign", chips=1)
+            foreign.spec.scheduler_name = "default-scheduler"
+            d.create_pod(foreign)
+            time.sleep(0.2)
+            assert d.get_pod("foreign").spec.node_name == ""
+        finally:
+            sched.stop()
+
+    def test_reserve_failure_rolls_back(self):
+        server = APIServer()
+        d = Descriptor(server)
+        server.create(mk_node("n1", chips=8))
+
+        events = []
+
+        class FailingReserve(ReservePlugin):
+            name = "FailingReserve"
+
+            def reserve(self, state, pod, node_name):
+                events.append(("reserve", pod.metadata.name))
+                return Status.unschedulable("always refuses")
+
+            def unreserve(self, state, pod, node_name):
+                events.append(("unreserve", pod.metadata.name))
+
+        sched = make_scheduler(
+            server,
+            extra_profile=lambda s: Profile(
+                filter=[FitFilter()], reserve=[FailingReserve()]
+            ),
+        )
+        sched.start()
+        try:
+            d.create_pod(mk_pod("p", chips=2))
+            assert wait_until(lambda: ("unreserve", "p") in events)
+            # chips credited back after forget
+            assert wait_until(lambda: sched.cache.snapshot()["n1"].free_tpu == 8)
+            assert d.get_pod("p").spec.node_name == ""
+        finally:
+            sched.stop()
+
+    def test_permit_wait_then_allow_binds(self):
+        server = APIServer()
+        d = Descriptor(server)
+        server.create(mk_node("n1", chips=8))
+
+        class WaitingPermit(PermitPlugin):
+            name = "WaitingPermit"
+
+            def permit(self, state, pod, node_name):
+                return Status.wait(), 5.0
+
+        sched = make_scheduler(
+            server,
+            extra_profile=lambda s: Profile(
+                filter=[FitFilter()], permit=[WaitingPermit()]
+            ),
+        )
+        sched.start()
+        try:
+            p = mk_pod("gated", chips=1)
+            created = d.create_pod(p)
+            uid = created.metadata.uid
+            assert wait_until(lambda: sched.handle.get_waiting_pod(uid) is not None)
+            time.sleep(0.1)
+            assert d.get_pod("gated").spec.node_name == ""  # still parked
+            sched.handle.get_waiting_pod(uid).allow("WaitingPermit")
+            assert wait_until(lambda: d.get_pod("gated").spec.node_name == "n1")
+        finally:
+            sched.stop()
+
+    def test_permit_timeout_rejects_and_requeues(self):
+        server = APIServer()
+        d = Descriptor(server)
+        server.create(mk_node("n1", chips=8))
+
+        class ShortWaitPermit(PermitPlugin):
+            name = "ShortWaitPermit"
+
+            def permit(self, state, pod, node_name):
+                return Status.wait(), 0.05
+
+        sched = make_scheduler(
+            server,
+            extra_profile=lambda s: Profile(
+                filter=[FitFilter()], permit=[ShortWaitPermit()]
+            ),
+        )
+        sched.start()
+        try:
+            d.create_pod(mk_pod("gated", chips=4))
+            # times out, chips credited back, pod requeued (and will wait
+            # again — we just assert the rollback happened)
+            assert wait_until(
+                lambda: "timed out" in sched.failure_reasons.get("default/gated", "")
+            )
+            assert d.get_pod("gated").spec.node_name == ""
+        finally:
+            sched.stop()
+
+    def test_post_bind_runs_after_binding(self):
+        server = APIServer()
+        d = Descriptor(server)
+        server.create(mk_node("n1", chips=8))
+        seen = []
+
+        class Recorder(PostBindPlugin):
+            name = "Recorder"
+
+            def post_bind(self, state, pod, node_name):
+                seen.append((pod.metadata.name, node_name, d.get_pod(pod.metadata.name).spec.node_name))
+
+        sched = make_scheduler(
+            server,
+            extra_profile=lambda s: Profile(filter=[FitFilter()], post_bind=[Recorder()]),
+        )
+        sched.start()
+        try:
+            d.create_pod(mk_pod("p", chips=1))
+            assert wait_until(lambda: len(seen) == 1)
+            # post_bind observed the pod already bound
+            assert seen[0] == ("p", "n1", "n1")
+        finally:
+            sched.stop()

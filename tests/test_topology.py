@@ -128,3 +128,13 @@ def test_has_wraparound(gen, topo, want):
 )
 def test_sub_host_partitions_are_single_host(gen, topo, hosts):
     assert SliceTopology.parse(gen, topo).hosts == hosts
+
+
+def test_oversized_axis_not_single_host():
+    # 4x1x1 has a 4-long axis no 2x2x1 board holds, and its 1-axes can't
+    # tile whole boards either — not a GKE topology, rejected.
+    with pytest.raises(ValueError):
+        hosts_needed(parse_topology("4x1x1"), TPUGen.V5P)
+    # 1x8 on v5e exceeds the 2x4 board and can't tile 2x2 boards either.
+    with pytest.raises(ValueError):
+        hosts_needed(parse_topology("1x8"), TPUGen.V5E)
