@@ -17,7 +17,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from ..api.objects import Node, Pod, TPU_RESOURCE
+from ..api.objects import Node, ObjectMeta, Pod, TPU_RESOURCE
 from ..api.topology import SliceTopology, TPUGen
 
 
@@ -91,6 +91,11 @@ class Cache:
             self._nodes.pop(node.metadata.name, None)
 
     # -- pod events (from the watch) --------------------------------------
+    #
+    # All of these are IDEMPOTENT: adding a pod already accounted is a
+    # no-op object refresh, removing one already gone is a no-op. The watch
+    # can deliver redundant events (terminal update followed by DELETE, a
+    # replayed ADD) and accounting must never double-debit or double-credit.
     def add_pod(self, pod: Pod) -> None:
         if not pod.spec.node_name:
             return
@@ -100,13 +105,13 @@ class Cache:
             if assumed is not None:
                 a_pod, a_node = assumed
                 if a_node != pod.spec.node_name:
-                    # bound somewhere else than assumed — credit the debit
-                    self._debit(a_node, -a_pod.spec.tpu_chips(), a_pod, remove=True)
+                    # bound somewhere else than assumed — move the debit
+                    self._remove_locked(a_node, a_pod)
                 else:
                     # already debited by assume; just swap the pod object in
-                    self._replace_pod(a_node, pod)
+                    self._refresh_locked(a_node, pod)
                     return
-            self._debit(pod.spec.node_name, pod.spec.tpu_chips(), pod)
+            self._add_locked(pod.spec.node_name, pod)
 
     def update_pod(self, old: Optional[Pod], new: Pod) -> None:
         if old is not None and old.spec.node_name and old.spec.node_name != new.spec.node_name:
@@ -115,19 +120,24 @@ class Cache:
             self.add_pod(new)
             return
         with self._mu:
-            self._replace_pod(new.spec.node_name, new)
+            self._refresh_locked(new.spec.node_name, new)
 
     def delete_pod(self, pod: Pod) -> None:
         if not pod.spec.node_name:
             return
         with self._mu:
-            self._debit(pod.spec.node_name, -pod.spec.tpu_chips(), pod, remove=True)
+            self._remove_locked(pod.spec.node_name, pod)
 
     # -- assume / forget ---------------------------------------------------
     def assume(self, pod: Pod, node_name: str) -> None:
         with self._mu:
+            prev = self._assumed.get(pod.metadata.uid)
+            if prev is not None:
+                if prev[1] == node_name:
+                    return  # already assumed here — idempotent
+                self._remove_locked(prev[1], prev[0])
             self._assumed[pod.metadata.uid] = (pod, node_name)
-            self._debit(node_name, pod.spec.tpu_chips(), pod)
+            self._add_locked(node_name, pod)
 
     def finish_binding(self, pod: Pod) -> None:
         # No-op beyond bookkeeping: the assumed entry is reconciled when the
@@ -140,7 +150,7 @@ class Cache:
             if assumed is None:
                 return
             a_pod, a_node = assumed
-            self._debit(a_node, -a_pod.spec.tpu_chips(), a_pod, remove=True)
+            self._remove_locked(a_node, a_pod)
 
     def is_assumed(self, pod: Pod) -> bool:
         with self._mu:
@@ -158,31 +168,43 @@ class Cache:
             return list(self._nodes)
 
     # -- internals (call with lock held) ----------------------------------
-    def _debit(self, node_name: str, chips: int, pod: Pod, remove: bool = False) -> None:
+    def _node_info(self, node_name: str) -> NodeInfo:
         info = self._nodes.get(node_name)
         if info is None:
-            # Node not (yet) known — create a placeholder so accounting
-            # survives pod-before-node event ordering.
-            info = NodeInfo(node=Node.__new__(Node))
-            from ..api.objects import NodeStatus, ObjectMeta  # local to avoid cycle
-
-            info.node = Node(metadata=ObjectMeta(name=node_name))
+            # Node not (yet) known — placeholder so accounting survives
+            # pod-before-node watch ordering; add_node fills in the object.
+            info = NodeInfo(node=Node(metadata=ObjectMeta(name=node_name)))
             self._nodes[node_name] = info
-        info.requested_tpu += chips
-        if remove:
-            info.pods = [p for p in info.pods if p.metadata.uid != pod.metadata.uid]
-        else:
-            self._replace_pod_in(info, pod)
+        return info
 
-    def _replace_pod(self, node_name: str, pod: Pod) -> None:
+    def _add_locked(self, node_name: str, pod: Pod) -> None:
+        info = self._node_info(node_name)
+        for i, p in enumerate(info.pods):
+            if p.metadata.uid == pod.metadata.uid:
+                info.pods[i] = pod  # already accounted — refresh only
+                return
+        info.pods.append(pod)
+        info.requested_tpu += pod.spec.tpu_chips()
+
+    def _remove_locked(self, node_name: str, pod: Pod) -> None:
         info = self._nodes.get(node_name)
-        if info is not None:
-            self._replace_pod_in(info, pod)
+        if info is None:
+            return
+        for i, p in enumerate(info.pods):
+            if p.metadata.uid == pod.metadata.uid:
+                del info.pods[i]
+                info.requested_tpu -= p.spec.tpu_chips()
+                return
+        # not present — already credited; no-op
 
-    @staticmethod
-    def _replace_pod_in(info: NodeInfo, pod: Pod) -> None:
+    def _refresh_locked(self, node_name: str, pod: Pod) -> None:
+        """Swap the stored object WITHOUT touching accounting; ignores pods
+        the cache no longer tracks (e.g. an update trailing a terminal
+        credit)."""
+        info = self._nodes.get(node_name)
+        if info is None:
+            return
         for i, p in enumerate(info.pods):
             if p.metadata.uid == pod.metadata.uid:
                 info.pods[i] = pod
                 return
-        info.pods.append(pod)

@@ -111,9 +111,17 @@ class SchedulingQueue:
                     if pod.metadata.uid in self._queued_uids and pod.metadata.uid not in self._backoff:
                         return pod
                     # stale entry (removed or re-backed-off) — skip
-                wait = self._next_wait(deadline)
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
+                    return None  # None strictly means timeout or close
+                waits = []
+                if deadline is not None:
+                    waits.append(deadline - now)
+                if self._backoff:
+                    waits.append(min(r for r, _ in self._backoff.values()) - now)
+                wait = min(waits) if waits else None
                 if wait is not None and wait <= 0:
-                    return None
+                    continue  # a backoff entry became ready — re-promote
                 self._mu.wait(timeout=wait)
 
     def pending_count(self) -> int:
@@ -134,12 +142,3 @@ class SchedulingQueue:
                 del self._backoff[uid]
                 self._push(pod)
 
-    def _next_wait(self, deadline: Optional[float]) -> Optional[float]:
-        """Seconds to sleep before something can happen; None = forever."""
-        candidates = []
-        if deadline is not None:
-            candidates.append(deadline - time.monotonic())
-        if self._backoff:
-            soonest = min(ready for ready, _ in self._backoff.values())
-            candidates.append(soonest - time.monotonic())
-        return min(candidates) if candidates else None

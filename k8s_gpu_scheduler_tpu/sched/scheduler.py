@@ -87,19 +87,26 @@ class Scheduler:
     def _ours(self, pod: Pod) -> bool:
         return pod.spec.scheduler_name == self.config.scheduler_name
 
+    @staticmethod
+    def _terminal(pod: Pod) -> bool:
+        return pod.status.phase in ("Succeeded", "Failed")
+
     def _on_pod_add(self, pod: Pod) -> None:
         if pod.spec.node_name:
-            self.cache.add_pod(pod)
+            if not self._terminal(pod):  # finished pods hold no chips
+                self.cache.add_pod(pod)
         elif self._ours(pod) and pod.status.phase == "Pending":
             self.queue.add(pod)
 
     def _on_pod_update(self, old: Optional[Pod], new: Pod) -> None:
         if new.spec.node_name:
-            self.cache.update_pod(old, new)
-            if new.status.phase in ("Succeeded", "Failed"):
-                # Terminal pods release their chips.
+            if self._terminal(new):
+                # Terminal pods release their chips (idempotent vs. the
+                # following DELETE event).
                 self.cache.delete_pod(new)
                 self.queue.move_all_to_active("pod-finished")
+            else:
+                self.cache.update_pod(old, new)
         elif self._ours(new) and new.status.phase == "Pending":
             self.queue.add(new)
 
@@ -122,6 +129,9 @@ class Scheduler:
     def stop(self) -> None:
         self._stop.set()
         self.queue.close()
+        # Wake binder threads parked in Permit WAIT so shutdown doesn't
+        # block for the remaining permit timeout.
+        self.handle.iterate_waiting_pods(lambda wp: wp.reject("scheduler shutting down"))
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
@@ -184,27 +194,34 @@ class Scheduler:
         best = self._select_node(state, pod, feasible)
 
         # Reserve: debit the cache first so concurrent cycles see the chips
-        # taken, then run Reserve plugins (scheduler-local state only).
+        # taken, then run Reserve plugins (scheduler-local state only). Any
+        # failure OR exception past this point must credit the chips back —
+        # a leaked assume would permanently shrink the node.
         self.cache.assume(pod, best)
-        for pl in self.profile.reserve:
-            st = pl.reserve(state, pod, best)
-            if not st.ok:
-                self._record_failure(pod, f"{pl.name}: {st.message}")
-                self._abort_after_assume(state, pod, best)
-                return
+        try:
+            for pl in self.profile.reserve:
+                st = pl.reserve(state, pod, best)
+                if not st.ok:
+                    self._record_failure(pod, f"{pl.name}: {st.message}")
+                    self._abort_after_assume(state, pod, best)
+                    return
 
-        # Permit: may park the pod (gang admission).
-        wait_plugins: List[str] = []
-        wait_timeout = self.config.permit_timeout_s
-        for pl in self.profile.permit:
-            st, timeout = pl.permit(state, pod, best)
-            if st.code == WAIT:
-                wait_plugins.append(pl.name)
-                wait_timeout = min(wait_timeout, timeout) if timeout > 0 else wait_timeout
-            elif not st.ok:
-                self._record_failure(pod, f"{pl.name}: {st.message}")
-                self._abort_after_assume(state, pod, best)
-                return
+            # Permit: may park the pod (gang admission).
+            wait_plugins: List[str] = []
+            wait_timeout = self.config.permit_timeout_s
+            for pl in self.profile.permit:
+                st, timeout = pl.permit(state, pod, best)
+                if st.code == WAIT:
+                    wait_plugins.append(pl.name)
+                    wait_timeout = min(wait_timeout, timeout) if timeout > 0 else wait_timeout
+                elif not st.ok:
+                    self._record_failure(pod, f"{pl.name}: {st.message}")
+                    self._abort_after_assume(state, pod, best)
+                    return
+        except Exception as e:  # noqa: BLE001 — plugin raised instead of returning Status
+            self._record_failure(pod, f"plugin exception: {e}")
+            self._abort_after_assume(state, pod, best)
+            return
 
         if wait_plugins:
             wp = WaitingPod(pod, best, wait_plugins)
