@@ -21,7 +21,10 @@ class Informer:
     def __init__(self, server: APIServer, kind: str) -> None:
         self._server = server
         self.kind = kind
-        self._mu = threading.Lock()
+        # RLock: add_event_handler replays synthetic ADDs while holding the
+        # lock (ordering guarantee below), and a handler may legitimately
+        # call back into list()/get().
+        self._mu = threading.RLock()
         self._cache: Dict[str, Any] = {}
         self._synced = threading.Event()
         self._watch: Optional[Watch] = None
@@ -125,18 +128,23 @@ class Informer:
             h["on_update"] = on_update
         if on_delete:
             h["on_delete"] = on_delete
-        # Append + cache snapshot under one lock acquisition: _apply updates
-        # the cache and snapshots handlers under the same lock, so an object
-        # arrives either via the watch dispatch (handler already appended) or
-        # via this replay (object already cached) — never both.
+        # Append + replay in ONE critical section: _apply updates the cache
+        # and snapshots handlers under the same lock, so an object arrives
+        # either via the watch dispatch (handler already appended) or via
+        # this replay (object already cached) — never both. Replaying while
+        # still holding the lock also guarantees ordering: a concurrent
+        # DELETE/MODIFY for a replayed object cannot reach this handler
+        # before its synthetic ADD, because the watch thread's cache update
+        # (which precedes its dispatch) blocks on the lock until the replay
+        # finishes.
         with self._mu:
             self._handlers.append(h)
-            replay = list(self._cache.values()) if (on_add and self._synced.is_set()) else []
-        for obj in replay:
-            try:
-                on_add(obj)
-            except Exception:  # noqa: BLE001
-                log.exception("informer %s synthetic add failed", self.kind)
+            if on_add and self._synced.is_set():
+                for obj in list(self._cache.values()):
+                    try:
+                        on_add(obj)
+                    except Exception:  # noqa: BLE001
+                        log.exception("informer %s synthetic add failed", self.kind)
 
     def has_synced(self) -> bool:
         return self._synced.is_set()

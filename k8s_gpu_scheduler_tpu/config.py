@@ -13,7 +13,7 @@ the way the reference's recommender already reads PORT/JOB_DELAY
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field, fields
+from dataclasses import dataclass, field
 from typing import Optional
 
 

@@ -13,7 +13,6 @@ shape/generation ride on the GKE node labels.
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 

@@ -60,15 +60,6 @@ class SchedulingQueue:
             self._backoff[pod.metadata.uid] = (time.monotonic() + delay, pod)
             self._mu.notify()
 
-    def requeue(self, pod: Pod) -> None:
-        """Immediate retry (transient error, not an unschedulable verdict)."""
-        with self._mu:
-            if pod.metadata.uid in self._backoff:
-                return
-            self._queued_uids.setdefault(pod.metadata.uid, 0)
-            self._push(pod)
-            self._mu.notify()
-
     def remove(self, pod: Pod) -> None:
         """Pod deleted while queued."""
         with self._mu:

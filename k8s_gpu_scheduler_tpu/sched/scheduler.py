@@ -32,7 +32,6 @@ from .framework import (
     Handle,
     Profile,
     Status,
-    SUCCESS,
     UNSCHEDULABLE,
     WAIT,
     WaitingPod,
@@ -116,6 +115,8 @@ class Scheduler:
             self.queue.move_all_to_active("pod-deleted")
         else:
             self.queue.remove(pod)
+        with self._fail_mu:
+            self.failure_reasons.pop(pod.metadata.key, None)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -129,12 +130,13 @@ class Scheduler:
     def stop(self) -> None:
         self._stop.set()
         self.queue.close()
-        # Wake binder threads parked in Permit WAIT so shutdown doesn't
-        # block for the remaining permit timeout.
-        self.handle.iterate_waiting_pods(lambda wp: wp.reject("scheduler shutting down"))
+        # Join the cycle thread FIRST so no new waiting pod can be parked
+        # after the reject pass below — otherwise shutdown could block for
+        # that pod's full permit timeout.
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        self.handle.iterate_waiting_pods(lambda wp: wp.reject("scheduler shutting down"))
         self._binder.shutdown(wait=True)
         self.factory.stop()
 
@@ -218,17 +220,20 @@ class Scheduler:
                     self._record_failure(pod, f"{pl.name}: {st.message}")
                     self._abort_after_assume(state, pod, best)
                     return
+
+            # submit can itself raise (executor shut down mid-cycle) — the
+            # enclosing except must credit the chips back then too.
+            if wait_plugins:
+                wp = WaitingPod(pod, best, wait_plugins)
+                self.handle.add_waiting_pod(wp)
+                self._binder.submit(self._wait_then_bind, state, wp, wait_timeout)
+            else:
+                self._binder.submit(self._bind, state, pod, best)
         except Exception as e:  # noqa: BLE001 — plugin raised instead of returning Status
+            self.handle.remove_waiting_pod(pod.metadata.uid)
             self._record_failure(pod, f"plugin exception: {e}")
             self._abort_after_assume(state, pod, best)
             return
-
-        if wait_plugins:
-            wp = WaitingPod(pod, best, wait_plugins)
-            self.handle.add_waiting_pod(wp)
-            self._binder.submit(self._wait_then_bind, state, wp, wait_timeout)
-        else:
-            self._binder.submit(self._bind, state, pod, best)
 
     def _select_node(self, state: CycleState, pod: Pod, feasible: List[NodeInfo]) -> str:
         if len(feasible) == 1 or not self.profile.score:
