@@ -1,0 +1,23 @@
+"""KV registry layer (SURVEY.md L2) — Python client + typed inventory schema
+for the native C++ kvstored server (native/kvstore)."""
+from .client import AuthError, Client, RegistryError
+from .inventory import (
+    ChipInfo,
+    NodeInventory,
+    list_inventories,
+    node_key,
+    publish_inventory,
+    read_inventory,
+)
+
+__all__ = [
+    "AuthError",
+    "Client",
+    "RegistryError",
+    "ChipInfo",
+    "NodeInventory",
+    "list_inventories",
+    "node_key",
+    "publish_inventory",
+    "read_inventory",
+]

@@ -1,0 +1,178 @@
+"""RESP client for the kvstored registry — parity with the reference's
+go-redis wrapper (pkg/redis/client/client.go:12-67: ``Client`` interface with
+Set/Get/GetRange/GetKeys/FlushRedis and ``New(addr, password, db)``).
+
+Pure-stdlib socket client: no redis-py dependency, works against kvstored or
+a real Redis. Thread safety: one lock per client serializes request/response
+pairs (the reference creates a fresh go-redis client per call instead —
+gpu_plugins.go:534; pooling here avoids that per-call dial).
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import List, Optional
+
+
+class RegistryError(Exception):
+    pass
+
+
+class AuthError(RegistryError):
+    pass
+
+
+class Client:
+    """``New(addr, password, db)`` parity (client.go:54-67)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 32767,
+        password: Optional[str] = None,
+        db: int = 0,
+        timeout_s: float = 5.0,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self._password = password
+        self._db = db
+        self._timeout = timeout_s
+        self._mu = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._buf = b""
+
+    # -- connection --------------------------------------------------------
+    def _connect(self) -> None:
+        s = socket.create_connection((self.host, self.port), timeout=self._timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+        self._buf = b""
+        if self._password:
+            try:
+                reply = self._roundtrip_locked(["AUTH", self._password])
+            except AuthError:
+                raise
+            except RegistryError as e:
+                raise AuthError(f"AUTH failed: {e}") from e
+            if reply != "OK":
+                raise AuthError(f"AUTH failed: {reply}")
+        if self._db:
+            reply = self._roundtrip_locked(["SELECT", str(self._db)])
+            if reply != "OK":
+                raise RegistryError(f"SELECT failed: {reply}")
+
+    def close(self) -> None:
+        with self._mu:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    def __enter__(self) -> "Client":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- protocol ----------------------------------------------------------
+    def _send(self, argv: List[str]) -> None:
+        out = [f"*{len(argv)}\r\n".encode()]
+        for a in argv:
+            data = a.encode() if isinstance(a, str) else a
+            out.append(f"${len(data)}\r\n".encode() + data + b"\r\n")
+        assert self._sock is not None
+        self._sock.sendall(b"".join(out))
+
+    def _read_line(self) -> bytes:
+        assert self._sock is not None
+        while b"\r\n" not in self._buf:
+            chunk = self._sock.recv(4096)
+            if not chunk:
+                raise RegistryError("connection closed by server")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        assert self._sock is not None
+        while len(self._buf) < n:
+            chunk = self._sock.recv(4096)
+            if not chunk:
+                raise RegistryError("connection closed by server")
+            self._buf += chunk
+        data, self._buf = self._buf[:n], self._buf[n:]
+        return data
+
+    def _read_reply(self):
+        line = self._read_line()
+        kind, rest = line[:1], line[1:].decode()
+        if kind == b"+":
+            return rest
+        if kind == b"-":
+            if rest.startswith("NOAUTH"):
+                raise AuthError(rest)
+            raise RegistryError(rest)
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            data = self._read_exact(n + 2)[:-2]
+            return data.decode()
+        if kind == b"*":
+            return [self._read_reply() for _ in range(int(rest))]
+        raise RegistryError(f"bad reply line: {line!r}")
+
+    def _roundtrip_locked(self, argv: List[str]):
+        self._send(argv)
+        return self._read_reply()
+
+    def _call(self, *argv: str):
+        with self._mu:
+            if self._sock is None:
+                self._connect()
+            try:
+                return self._roundtrip_locked(list(argv))
+            except (OSError, RegistryError):
+                # One reconnect attempt (server restarted, idle timeout...).
+                try:
+                    if self._sock is not None:
+                        self._sock.close()
+                finally:
+                    self._sock = None
+                self._connect()
+                return self._roundtrip_locked(list(argv))
+
+    # -- API parity with client.go:26-67 ----------------------------------
+    def set(self, key: str, value: str) -> None:
+        reply = self._call("SET", key, value)
+        if reply != "OK":
+            raise RegistryError(f"SET: {reply}")
+
+    def get(self, key: str) -> Optional[str]:
+        return self._call("GET", key)
+
+    def get_range(self, key: str, start: int, end: int) -> str:
+        return self._call("GETRANGE", key, str(start), str(end)) or ""
+
+    def get_keys(self, pattern: str = "*") -> List[str]:
+        return list(self._call("KEYS", pattern))
+
+    def delete(self, *keys: str) -> int:
+        return int(self._call("DEL", *keys))
+
+    def exists(self, key: str) -> bool:
+        return bool(self._call("EXISTS", key))
+
+    def dbsize(self) -> int:
+        return int(self._call("DBSIZE"))
+
+    def flush(self) -> None:
+        """FlushRedis parity (client.go:48-52)."""
+        self._call("FLUSHDB")
+
+    def ping(self) -> bool:
+        return self._call("PING") == "PONG"

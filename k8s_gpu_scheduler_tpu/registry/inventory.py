@@ -1,0 +1,96 @@
+"""Typed node-inventory schema stored in the registry.
+
+The reference stores an untyped JSON list of UUID strings per node
+(``nodeName → ["GPU-…", "MIG-…"]``, written by the profiler client at
+pkg/profiler/cmd/client/client.go:70-79, read back by the scheduler at
+gpu_plugins.go:536-542). The TPU analogue is richer — a node publishes its
+chip inventory, slice shape/generation, and live utilization — so the schema
+is typed here once and shared by the agent (writer) and scheduler (reader),
+per SURVEY.md §7 step 2 ("typed inventory schema
+node → {chips, slice shape, topology coords}").
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+# Key layout in the registry (db 0).
+NODE_KEY_PREFIX = "node/"          # node/<name>   -> NodeInventory JSON
+HEARTBEAT_SUFFIX = "/heartbeat"    # node/<name>/heartbeat -> unix ts
+
+
+def node_key(node_name: str) -> str:
+    return NODE_KEY_PREFIX + node_name
+
+
+@dataclass
+class ChipInfo:
+    """One TPU chip as the agent sees it (device id within the host)."""
+
+    device_id: int
+    # Torus coordinates of the chip within its slice, e.g. [0, 1] / [0, 1, 0].
+    coords: List[int] = field(default_factory=list)
+    # Live utilization 0..1 (MXU duty cycle), HBM bytes.
+    duty_cycle: float = 0.0
+    hbm_used_bytes: int = 0
+    hbm_total_bytes: int = 0
+
+
+@dataclass
+class NodeInventory:
+    node_name: str
+    # GKE label values: accelerator type + slice topology.
+    accelerator: str = ""
+    topology: str = ""
+    chips: List[ChipInfo] = field(default_factory=list)
+    # Worker index of this host within a multi-host slice (the value the
+    # scheduler injects as TPU_WORKER_ID's base).
+    worker_id: int = 0
+    # Mean MXU duty cycle over the chips, 0..1 — the Score input replacing
+    # the reference's DCGM_FI_PROF_GR_ENGINE_ACTIVE (prom_metrics.go:64).
+    utilization: float = 0.0
+    published_at: float = 0.0
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), sort_keys=True)
+
+    @staticmethod
+    def from_json(raw: str) -> "NodeInventory":
+        d = json.loads(raw)
+        chips = [ChipInfo(**c) for c in d.pop("chips", [])]
+        return NodeInventory(chips=chips, **d)
+
+
+def publish_inventory(client, inv: NodeInventory) -> None:
+    """Agent-side write (parity: profiler client Set(nodeName, jsonUuids),
+    cmd/client/client.go:70-79 — but typed)."""
+    client.set(node_key(inv.node_name), inv.to_json())
+
+
+def read_inventory(client, node_name: str) -> Optional[NodeInventory]:
+    """Scheduler-side read (parity: redis Get(nodeName) + JSON decode,
+    gpu_plugins.go:536-542)."""
+    raw = client.get(node_key(node_name))
+    if raw is None:
+        return None
+    try:
+        return NodeInventory.from_json(raw)
+    except (ValueError, TypeError, KeyError):
+        return None
+
+
+def list_inventories(client) -> Dict[str, NodeInventory]:
+    out: Dict[str, NodeInventory] = {}
+    for key in client.get_keys(NODE_KEY_PREFIX + "*"):
+        if key.endswith(HEARTBEAT_SUFFIX):
+            continue
+        raw = client.get(key)
+        if raw is None:
+            continue
+        try:
+            inv = NodeInventory.from_json(raw)
+        except (ValueError, TypeError, KeyError):
+            continue
+        out[inv.node_name] = inv
+    return out

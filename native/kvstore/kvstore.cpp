@@ -1,0 +1,430 @@
+// kvstored — TPU-inventory KV registry speaking RESP2.
+//
+// The reference parks its GPU-UUID registry in a stock Redis StatefulSet
+// (deploy/redis/, NodePort 32767, requirepass — SURVEY.md §2 C20) and talks
+// to it via go-redis (pkg/redis/client/client.go:26-67: Set/Get/GetRange/
+// GetKeys/FlushRedis). Our registry is this single-binary C++ server: the
+// repo's native-component obligation (SURVEY.md §2 native checklist — the
+// reference's only C++, pkg/profiler/gpu_profiling.cpp, is dead code). It
+// speaks enough RESP that any redis client can drive it:
+//
+//   PING AUTH SELECT SET GET GETRANGE DEL EXISTS KEYS DBSIZE
+//   FLUSHDB FLUSHALL QUIT COMMAND INFO
+//
+// plus append-only persistence (--appendonly FILE replays a RESP command log
+// at startup — parity with the reference's Redis AOF-on-PV durability,
+// SURVEY.md §5 "Checkpoint / resume").
+//
+// Concurrency: thread-per-connection; one mutex over the 16-db store. The
+// write rate is node-agent inventory publishes (one key per node every few
+// seconds) — contention is not a concern; simplicity and auditability are.
+//
+// Build: make (g++ -std=c++17 -O2 -pthread). No dependencies.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <array>
+#include <cctype>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kNumDbs = 16;
+
+struct Store {
+  std::mutex mu;
+  std::array<std::unordered_map<std::string, std::string>, kNumDbs> dbs;
+  std::ofstream aof;
+  bool aof_enabled = false;
+};
+
+Store g_store;
+std::string g_password;  // empty = no auth required
+
+// --- RESP writing -----------------------------------------------------------
+
+std::string simple(const std::string& s) { return "+" + s + "\r\n"; }
+std::string err(const std::string& s) { return "-ERR " + s + "\r\n"; }
+std::string integer(long long n) { return ":" + std::to_string(n) + "\r\n"; }
+std::string bulk(const std::string& s) {
+  return "$" + std::to_string(s.size()) + "\r\n" + s + "\r\n";
+}
+std::string null_bulk() { return "$-1\r\n"; }
+std::string array_hdr(size_t n) { return "*" + std::to_string(n) + "\r\n"; }
+
+// --- glob matching for KEYS (supports * ? [abc]) ----------------------------
+
+bool glob_match(const char* pat, const char* str) {
+  while (*pat) {
+    switch (*pat) {
+      case '*': {
+        pat++;
+        if (!*pat) return true;
+        for (const char* s = str; ; s++) {
+          if (glob_match(pat, s)) return true;
+          if (!*s) return false;
+        }
+      }
+      case '?':
+        if (!*str) return false;
+        pat++, str++;
+        break;
+      case '[': {
+        if (!*str) return false;
+        const char* p = pat + 1;
+        bool neg = (*p == '^');
+        if (neg) p++;
+        bool matched = false;
+        while (*p && *p != ']') {
+          if (p[1] == '-' && p[2] && p[2] != ']') {
+            if (*str >= *p && *str <= p[2]) matched = true;
+            p += 3;
+          } else {
+            if (*p == *str) matched = true;
+            p++;
+          }
+        }
+        if (*p != ']') return false;
+        if (matched == neg) return false;
+        pat = p + 1;
+        str++;
+        break;
+      }
+      default:
+        if (*pat != *str) return false;
+        pat++, str++;
+    }
+  }
+  return !*str;
+}
+
+// --- AOF --------------------------------------------------------------------
+
+std::mutex g_aof_mu;
+
+void aof_record(int db, const std::vector<std::string>& argv) {
+  if (!g_store.aof_enabled) return;
+  std::lock_guard<std::mutex> lk(g_aof_mu);
+  // Each record: db index, then the command, RESP-framed.
+  g_store.aof << "#" << db << "\r\n" << array_hdr(argv.size());
+  for (const auto& a : argv) g_store.aof << bulk(a);
+  g_store.aof.flush();
+}
+
+// --- command dispatch -------------------------------------------------------
+
+std::string upper(std::string s) {
+  std::transform(s.begin(), s.end(), s.begin(),
+                 [](unsigned char c) { return std::toupper(c); });
+  return s;
+}
+
+struct Session {
+  bool authed = g_password.empty();
+  int db = 0;
+};
+
+// Applies a (possibly replayed) command against the store. Returns the RESP
+// response. `record` controls AOF logging (false during replay).
+std::string execute(Session& sess, const std::vector<std::string>& argv, bool record) {
+  if (argv.empty()) return err("empty command");
+  const std::string cmd = upper(argv[0]);
+
+  if (cmd == "QUIT") return simple("OK");
+  if (cmd == "AUTH") {
+    if (argv.size() != 2) return err("wrong number of arguments for 'auth'");
+    if (g_password.empty()) return err("Client sent AUTH, but no password is set");
+    if (argv[1] == g_password) {
+      sess.authed = true;
+      return simple("OK");
+    }
+    return err("invalid password");
+  }
+  if (!sess.authed) return "-NOAUTH Authentication required.\r\n";
+
+  if (cmd == "PING") return simple(argv.size() > 1 ? argv[1] : "PONG");
+  if (cmd == "COMMAND") return array_hdr(0);
+  if (cmd == "INFO") return bulk("# kvstored\r\nrole:master\r\n");
+  if (cmd == "SELECT") {
+    if (argv.size() != 2) return err("wrong number of arguments for 'select'");
+    int n = -1;
+    try {
+      n = std::stoi(argv[1]);
+    } catch (...) {
+    }
+    if (n < 0 || n >= kNumDbs) return err("DB index is out of range");
+    sess.db = n;
+    return simple("OK");
+  }
+
+  std::lock_guard<std::mutex> lk(g_store.mu);
+  auto& db = g_store.dbs[sess.db];
+
+  if (cmd == "SET") {
+    if (argv.size() != 3) return err("wrong number of arguments for 'set'");
+    db[argv[1]] = argv[2];
+    if (record) aof_record(sess.db, argv);
+    return simple("OK");
+  }
+  if (cmd == "GET") {
+    if (argv.size() != 2) return err("wrong number of arguments for 'get'");
+    auto it = db.find(argv[1]);
+    return it == db.end() ? null_bulk() : bulk(it->second);
+  }
+  if (cmd == "GETRANGE") {
+    // Parity with client.Descriptor.GetRange (client.go:36-40).
+    if (argv.size() != 4) return err("wrong number of arguments for 'getrange'");
+    auto it = db.find(argv[1]);
+    if (it == db.end()) return bulk("");
+    long long start = 0, end = -1;
+    try {
+      start = std::stoll(argv[2]);
+      end = std::stoll(argv[3]);
+    } catch (...) {
+      return err("value is not an integer or out of range");
+    }
+    long long len = static_cast<long long>(it->second.size());
+    if (start < 0) start = std::max(0LL, len + start);
+    if (end < 0) end = len + end;
+    end = std::min(end, len - 1);
+    if (start > end || len == 0) return bulk("");
+    return bulk(it->second.substr(start, end - start + 1));
+  }
+  if (cmd == "DEL") {
+    if (argv.size() < 2) return err("wrong number of arguments for 'del'");
+    long long removed = 0;
+    for (size_t i = 1; i < argv.size(); i++) removed += db.erase(argv[i]);
+    if (record && removed) aof_record(sess.db, argv);
+    return integer(removed);
+  }
+  if (cmd == "EXISTS") {
+    if (argv.size() < 2) return err("wrong number of arguments for 'exists'");
+    long long n = 0;
+    for (size_t i = 1; i < argv.size(); i++) n += db.count(argv[i]);
+    return integer(n);
+  }
+  if (cmd == "KEYS") {
+    // Parity with client.Descriptor.GetKeys (client.go:42-46).
+    if (argv.size() != 2) return err("wrong number of arguments for 'keys'");
+    std::vector<const std::string*> hits;
+    for (const auto& kv : db)
+      if (glob_match(argv[1].c_str(), kv.first.c_str())) hits.push_back(&kv.first);
+    std::string out = array_hdr(hits.size());
+    for (const auto* k : hits) out += bulk(*k);
+    return out;
+  }
+  if (cmd == "DBSIZE") return integer(static_cast<long long>(db.size()));
+  if (cmd == "FLUSHDB") {
+    // Parity with client.Descriptor.FlushRedis (client.go:48-52).
+    db.clear();
+    if (record) aof_record(sess.db, argv);
+    return simple("OK");
+  }
+  if (cmd == "FLUSHALL") {
+    for (auto& d : g_store.dbs) d.clear();
+    if (record) aof_record(sess.db, argv);
+    return simple("OK");
+  }
+  return err("unknown command '" + argv[0] + "'");
+}
+
+// --- RESP reading -----------------------------------------------------------
+
+class Reader {
+ public:
+  explicit Reader(int fd) : fd_(fd) {}
+
+  // Reads one command: RESP array of bulk strings, or an inline command.
+  // Returns false on EOF/protocol error.
+  bool next(std::vector<std::string>& argv) {
+    argv.clear();
+    std::string line;
+    if (!read_line(line)) return false;
+    if (line.empty()) return next(argv);
+    if (line[0] == '*') {
+      long long n = 0;
+      try {
+        n = std::stoll(line.substr(1));
+      } catch (...) {
+        return false;
+      }
+      if (n < 0 || n > 1024) return false;
+      for (long long i = 0; i < n; i++) {
+        std::string hdr;
+        if (!read_line(hdr) || hdr.empty() || hdr[0] != '$') return false;
+        long long len = 0;
+        try {
+          len = std::stoll(hdr.substr(1));
+        } catch (...) {
+          return false;
+        }
+        if (len < 0 || len > (64LL << 20)) return false;
+        std::string payload;
+        if (!read_exact(payload, static_cast<size_t>(len) + 2)) return false;
+        payload.resize(len);  // strip trailing \r\n
+        argv.push_back(std::move(payload));
+      }
+      return true;
+    }
+    // Inline command (telnet/netcat convenience — redis supports this too).
+    std::istringstream ss(line);
+    std::string tok;
+    while (ss >> tok) argv.push_back(tok);
+    return !argv.empty();
+  }
+
+ private:
+  bool fill() {
+    char buf[4096];
+    ssize_t n = recv(fd_, buf, sizeof(buf), 0);
+    if (n <= 0) return false;
+    buf_.append(buf, n);
+    return true;
+  }
+
+  bool read_line(std::string& out) {
+    size_t pos;
+    while ((pos = buf_.find("\r\n")) == std::string::npos) {
+      if (buf_.size() > (64u << 20)) return false;
+      if (!fill()) return false;
+    }
+    out = buf_.substr(0, pos);
+    buf_.erase(0, pos + 2);
+    return true;
+  }
+
+  bool read_exact(std::string& out, size_t n) {
+    while (buf_.size() < n)
+      if (!fill()) return false;
+    out = buf_.substr(0, n);
+    buf_.erase(0, n);
+    return true;
+  }
+
+  int fd_;
+  std::string buf_;
+};
+
+void serve_client(int fd) {
+  Session sess;
+  Reader reader(fd);
+  std::vector<std::string> argv;
+  while (reader.next(argv)) {
+    std::string resp = execute(sess, argv, /*record=*/true);
+    if (send(fd, resp.data(), resp.size(), MSG_NOSIGNAL) < 0) break;
+    if (!argv.empty() && upper(argv[0]) == "QUIT") break;
+  }
+  close(fd);
+}
+
+// --- AOF replay -------------------------------------------------------------
+
+void replay_aof(const std::string& path) {
+  std::ifstream in(path, std::ios::binary);
+  if (!in) return;
+  std::string content((std::istreambuf_iterator<char>(in)),
+                      std::istreambuf_iterator<char>());
+  size_t pos = 0;
+  Session sess;
+  sess.authed = true;
+  auto read_line = [&](std::string& out) -> bool {
+    size_t e = content.find("\r\n", pos);
+    if (e == std::string::npos) return false;
+    out = content.substr(pos, e - pos);
+    pos = e + 2;
+    return true;
+  };
+  std::string line;
+  while (read_line(line)) {
+    if (line.empty() || line[0] != '#') continue;
+    sess.db = std::stoi(line.substr(1));
+    std::string hdr;
+    if (!read_line(hdr) || hdr.empty() || hdr[0] != '*') break;
+    long long n = std::stoll(hdr.substr(1));
+    std::vector<std::string> argv;
+    bool ok = true;
+    for (long long i = 0; i < n && ok; i++) {
+      std::string bh;
+      ok = read_line(bh) && !bh.empty() && bh[0] == '$';
+      if (!ok) break;
+      long long len = std::stoll(bh.substr(1));
+      if (pos + len + 2 > content.size()) {
+        ok = false;
+        break;
+      }
+      argv.push_back(content.substr(pos, len));
+      pos += len + 2;
+    }
+    if (ok && !argv.empty()) execute(sess, argv, /*record=*/false);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 32767;
+  std::string aof_path;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    if (a == "--port" && i + 1 < argc) port = std::stoi(argv[++i]);
+    else if (a == "--requirepass" && i + 1 < argc) g_password = argv[++i];
+    else if (a == "--appendonly" && i + 1 < argc) aof_path = argv[++i];
+    else if (a == "--help") {
+      std::cout << "kvstored [--port N] [--requirepass PW] [--appendonly FILE]\n";
+      return 0;
+    }
+  }
+
+  if (!aof_path.empty()) {
+    replay_aof(aof_path);
+    g_store.aof.open(aof_path, std::ios::app | std::ios::binary);
+    g_store.aof_enabled = g_store.aof.good();
+  }
+
+  signal(SIGPIPE, SIG_IGN);
+
+  int listener = socket(AF_INET, SOCK_STREAM, 0);
+  if (listener < 0) {
+    perror("socket");
+    return 1;
+  }
+  int one = 1;
+  setsockopt(listener, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(listener, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(listener, 128) < 0) {
+    perror("listen");
+    return 1;
+  }
+  // If --port 0, report the kernel-assigned port so tests can connect.
+  socklen_t alen = sizeof(addr);
+  getsockname(listener, reinterpret_cast<sockaddr*>(&addr), &alen);
+  std::cout << "kvstored ready on port " << ntohs(addr.sin_port) << std::endl;
+
+  while (true) {
+    int fd = accept(listener, nullptr, nullptr);
+    if (fd < 0) continue;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(serve_client, fd).detach();
+  }
+}
