@@ -22,6 +22,16 @@ class AuthError(RegistryError):
     pass
 
 
+class ConnectionLost(RegistryError):
+    """Transport-level failure (as opposed to a server -ERR reply)."""
+
+
+# Commands safe to transparently re-send after a reconnect. DEL is absent on
+# purpose: re-sending it after a dropped reply would erase the key a second
+# time and report 0, lying to the caller about whether the key existed.
+_IDEMPOTENT = {"GET", "SET", "GETRANGE", "KEYS", "EXISTS", "DBSIZE", "PING", "INFO", "FLUSHDB"}
+
+
 class Client:
     """``New(addr, password, db)`` parity (client.go:54-67)."""
 
@@ -90,7 +100,7 @@ class Client:
         while b"\r\n" not in self._buf:
             chunk = self._sock.recv(4096)
             if not chunk:
-                raise RegistryError("connection closed by server")
+                raise ConnectionLost("connection closed by server")
             self._buf += chunk
         line, self._buf = self._buf.split(b"\r\n", 1)
         return line
@@ -100,7 +110,7 @@ class Client:
         while len(self._buf) < n:
             chunk = self._sock.recv(4096)
             if not chunk:
-                raise RegistryError("connection closed by server")
+                raise ConnectionLost("connection closed by server")
             self._buf += chunk
         data, self._buf = self._buf[:n], self._buf[n:]
         return data
@@ -136,13 +146,17 @@ class Client:
                 self._connect()
             try:
                 return self._roundtrip_locked(list(argv))
-            except (OSError, RegistryError):
-                # One reconnect attempt (server restarted, idle timeout...).
+            except (OSError, ConnectionLost):
+                # Transport died (server restarted, idle timeout). Drop the
+                # socket; transparently retry only idempotent commands —
+                # a -ERR reply never lands here (the server DID answer).
                 try:
                     if self._sock is not None:
                         self._sock.close()
                 finally:
                     self._sock = None
+                if argv[0].upper() not in _IDEMPOTENT:
+                    raise
                 self._connect()
                 return self._roundtrip_locked(list(argv))
 

@@ -31,6 +31,7 @@
 #include <algorithm>
 #include <array>
 #include <cctype>
+#include <cerrno>
 #include <cstring>
 #include <fstream>
 #include <iostream>
@@ -319,13 +320,28 @@ class Reader {
   std::string buf_;
 };
 
+bool send_all(int fd, const char* data, size_t len) {
+  // POSIX allows short counts from blocking send (large replies, EINTR) —
+  // a single send would silently truncate and desync the RESP stream.
+  size_t off = 0;
+  while (off < len) {
+    ssize_t n = send(fd, data + off, len - off, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
 void serve_client(int fd) {
   Session sess;
   Reader reader(fd);
   std::vector<std::string> argv;
   while (reader.next(argv)) {
     std::string resp = execute(sess, argv, /*record=*/true);
-    if (send(fd, resp.data(), resp.size(), MSG_NOSIGNAL) < 0) break;
+    if (!send_all(fd, resp.data(), resp.size())) break;
     if (!argv.empty() && upper(argv[0]) == "QUIT") break;
   }
   close(fd);
@@ -348,28 +364,51 @@ void replay_aof(const std::string& path) {
     pos = e + 2;
     return true;
   };
+  // A crash mid-aof_record leaves a truncated tail; replay applies every
+  // complete record and stops at the first malformed one instead of
+  // crashing startup or indexing out of range.
   std::string line;
   while (read_line(line)) {
     if (line.empty() || line[0] != '#') continue;
-    sess.db = std::stoi(line.substr(1));
+    int db = -1;
+    try {
+      db = std::stoi(line.substr(1));
+    } catch (...) {
+      break;
+    }
+    if (db < 0 || db >= kNumDbs) break;
+    sess.db = db;
     std::string hdr;
     if (!read_line(hdr) || hdr.empty() || hdr[0] != '*') break;
-    long long n = std::stoll(hdr.substr(1));
+    long long n = 0;
+    try {
+      n = std::stoll(hdr.substr(1));
+    } catch (...) {
+      break;
+    }
+    if (n <= 0 || n > 1024) break;
     std::vector<std::string> argv;
     bool ok = true;
     for (long long i = 0; i < n && ok; i++) {
       std::string bh;
       ok = read_line(bh) && !bh.empty() && bh[0] == '$';
       if (!ok) break;
-      long long len = std::stoll(bh.substr(1));
-      if (pos + len + 2 > content.size()) {
+      long long len = -1;
+      try {
+        len = std::stoll(bh.substr(1));
+      } catch (...) {
+        ok = false;
+        break;
+      }
+      if (len < 0 || pos + len + 2 > content.size()) {
         ok = false;
         break;
       }
       argv.push_back(content.substr(pos, len));
       pos += len + 2;
     }
-    if (ok && !argv.empty()) execute(sess, argv, /*record=*/false);
+    if (!ok) break;
+    execute(sess, argv, /*record=*/false);
   }
 }
 
@@ -378,13 +417,16 @@ void replay_aof(const std::string& path) {
 int main(int argc, char** argv) {
   int port = 32767;
   std::string aof_path;
+  std::string bind_addr = "0.0.0.0";  // cluster service: reachable by agents
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
     if (a == "--port" && i + 1 < argc) port = std::stoi(argv[++i]);
+    else if (a == "--bind" && i + 1 < argc) bind_addr = argv[++i];
     else if (a == "--requirepass" && i + 1 < argc) g_password = argv[++i];
     else if (a == "--appendonly" && i + 1 < argc) aof_path = argv[++i];
     else if (a == "--help") {
-      std::cout << "kvstored [--port N] [--requirepass PW] [--appendonly FILE]\n";
+      std::cout << "kvstored [--port N] [--bind ADDR] [--requirepass PW] "
+                   "[--appendonly FILE]\n";
       return 0;
     }
   }
@@ -406,7 +448,10 @@ int main(int argc, char** argv) {
   setsockopt(listener, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (inet_pton(AF_INET, bind_addr.c_str(), &addr.sin_addr) != 1) {
+    std::cerr << "bad --bind address: " << bind_addr << "\n";
+    return 1;
+  }
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (bind(listener, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
     perror("bind");

@@ -241,3 +241,53 @@ class TestCtl:
         assert ctl_main(base + ["-f"]) == 0
         capsys.readouterr()
         assert ctl_main(base + ["--get", "k1"]) == 1
+
+
+class TestReviewRegressions:
+    def test_corrupt_aof_does_not_crash_startup(self, tmp_path):
+        aof = tmp_path / "bad.aof"
+        # A good record, then a truncated/corrupt tail (crash mid-write).
+        good = b"#0\r\n*3\r\n$3\r\nSET\r\n$1\r\nk\r\n$1\r\nv\r\n"
+        aof.write_bytes(good + b"#99\r\n*3\r\n$3\r\nSET\r")
+        srv = KVServer(appendonly=str(aof))
+        try:
+            with Client(port=srv.port) as c:
+                assert c.get("k") == "v"  # complete prefix replayed
+        finally:
+            srv.stop()
+
+    def test_large_reply_not_truncated(self, server):
+        # Replies far larger than a socket buffer must arrive complete.
+        with Client(port=server.port) as c:
+            big = "x" * 300_000
+            c.set("big", big)
+            assert c.get("big") == big
+            for i in range(500):
+                c.set(f"many/{i:04d}", str(i))
+            keys = c.get_keys("many/*")
+            assert len(keys) == 500
+            # connection still in sync afterwards
+            assert c.ping()
+
+    def test_non_idempotent_command_not_retried(self, tmp_path):
+        srv = KVServer()
+        c = Client(port=srv.port)
+        c.set("k", "v")
+        port = srv.port
+        srv.stop()
+        proc = subprocess.Popen(
+            [BINARY, "--port", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            assert "ready" in proc.stdout.readline()
+            # DEL over the dead connection must surface the failure rather
+            # than silently re-running against the new server.
+            with pytest.raises(RegistryError):
+                c.delete("k")
+            # idempotent command transparently reconnects afterwards
+            assert c.ping()
+        finally:
+            c.close()
+            proc.terminate()
+            proc.wait(timeout=5)
