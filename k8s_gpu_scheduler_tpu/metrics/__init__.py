@@ -1,0 +1,35 @@
+"""Metrics layer (SURVEY.md L2): Prometheus instant-query client with
+concurrent TPU-series fan-out (consumer side, pkg/prom parity) and a
+text-exposition exporter for the scheduler's own metrics (producer side —
+new; the reference exports nothing, SURVEY.md §5)."""
+from .client import (
+    HBM_BANDWIDTH_UTIL,
+    HBM_TOTAL,
+    HBM_USED,
+    MXU_DUTY_CYCLE,
+    MetricsError,
+    PromClient,
+    Sample,
+    TENSORCORE_UTIL,
+    TPU_SERIES,
+    parse_response,
+)
+from .exporter import Counter, Gauge, Histogram, MetricsServer, Registry
+
+__all__ = [
+    "HBM_BANDWIDTH_UTIL",
+    "HBM_TOTAL",
+    "HBM_USED",
+    "MXU_DUTY_CYCLE",
+    "MetricsError",
+    "PromClient",
+    "Sample",
+    "TENSORCORE_UTIL",
+    "TPU_SERIES",
+    "parse_response",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsServer",
+    "Registry",
+]

@@ -1,0 +1,208 @@
+"""Prometheus-text-format metrics exporter for the scheduler itself.
+
+The reference *consumes* metrics but exports none of its own beyond what
+upstream kube-scheduler provides (SURVEY.md §5 "Metrics / observability":
+"The scheduler exposes no metrics of its own... the BASELINE north-star
+metric (p50 schedule latency) will require adding an exporter in the
+rebuild"). This module is that exporter: counters, gauges and histograms
+registered in a Registry, served as Prometheus text exposition on /metrics.
+The scheduler records its cycle/bind latencies here (scheduler.py), and
+bench.py reads the histogram back for the p50-schedule-latency number.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Deque, Dict, List, Optional, Tuple
+
+# Default latency buckets (seconds) — kube-scheduler's
+# scheduling_attempt_duration ladder, shortened.
+DEFAULT_BUCKETS = (0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0)
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str) -> None:
+        self.name = name
+        self.help = help_
+        self._mu = threading.Lock()
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._mu:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._mu:
+            return self._values.get(key, 0.0)
+
+    def expose(self) -> List[str]:
+        with self._mu:
+            items = list(self._values.items())
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, val in sorted(items):
+            lines.append(f"{self.name}{_fmt_labels(dict(key))} {val}")
+        return lines
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str) -> None:
+        self.name = name
+        self.help = help_
+        self._mu = threading.Lock()
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def set(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._mu:
+            self._values[key] = float(value)
+
+    def value(self, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._mu:
+            return self._values.get(key, 0.0)
+
+    def expose(self) -> List[str]:
+        with self._mu:
+            items = list(self._values.items())
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for key, val in sorted(items):
+            lines.append(f"{self.name}{_fmt_labels(dict(key))} {val}")
+        return lines
+
+
+class Histogram:
+    # Raw observations kept for quantile() are bounded: a long-running
+    # scheduler daemon observes every cycle, and an unbounded list would be
+    # a slow memory leak. 100k covers any bench run; beyond that the window
+    # slides (recent observations win, which is what a latency quantile
+    # should reflect anyway).
+    MAX_RAW_OBSERVATIONS = 100_000
+
+    def __init__(self, name: str, help_: str, buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> None:
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(sorted(buckets))
+        self._mu = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # +Inf bucket last
+        self._sum = 0.0
+        self._total = 0
+        self._observations: Deque[float] = deque(maxlen=self.MAX_RAW_OBSERVATIONS)
+
+    def observe(self, value: float) -> None:
+        with self._mu:
+            self._sum += value
+            self._total += 1
+            self._observations.append(value)
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        with self._mu:
+            return self._total
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Exact quantile over the (bounded window of) raw observations —
+        bench convenience; real Prometheus would estimate from buckets."""
+        with self._mu:
+            if not self._observations:
+                return None
+            xs = sorted(self._observations)
+        idx = min(len(xs) - 1, max(0, int(q * len(xs))))
+        return xs[idx]
+
+    def expose(self) -> List[str]:
+        with self._mu:
+            counts = list(self._counts)
+            total = self._total
+            s = self._sum
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        cumulative = 0
+        for b, c in zip(self.buckets, counts):
+            cumulative += c
+            lines.append(f'{self.name}_bucket{{le="{b}"}} {cumulative}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{self.name}_sum {s}")
+        lines.append(f"{self.name}_count {total}")
+        return lines
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(name, help_), Gauge)
+
+    def histogram(self, name: str, help_: str = "", buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(name, lambda: Histogram(name, help_, buckets), Histogram)
+
+    def _get_or_create(self, name, factory, klass):
+        with self._mu:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            elif not isinstance(m, klass):
+                raise TypeError(f"metric {name} already registered as {type(m).__name__}")
+            return m
+
+    def expose(self) -> str:
+        with self._mu:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.expose())  # type: ignore[attr-defined]
+        return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Serves a Registry at /metrics (Prometheus text exposition)."""
+
+    def __init__(self, registry: Registry, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.registry = registry
+        reg = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.split("?")[0] != "/metrics":
+                    self.send_error(404)
+                    return
+                body = reg.expose().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request logging
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    def start(self) -> "MetricsServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -156,7 +156,7 @@ class Client:
                 finally:
                     self._sock = None
                 if argv[0].upper() not in _IDEMPOTENT:
-                    raise
+                    raise ConnectionLost(f"{argv[0]} failed mid-flight (not retried)") from None
                 self._connect()
                 return self._roundtrip_locked(list(argv))
 

@@ -21,11 +21,14 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
+import time
+
 from ..api.objects import Pod
 from ..cluster.apiserver import APIServer
 from ..cluster.informers import SharedInformerFactory
 from ..cluster.resources import Descriptor
 from ..config import SchedulerConfig
+from ..metrics.exporter import Registry
 from .cache import Cache, NodeInfo
 from .framework import (
     CycleState,
@@ -47,8 +50,22 @@ class Scheduler:
         server: APIServer,
         profile: Optional[Profile] = None,
         config: Optional[SchedulerConfig] = None,
+        metrics: Optional[Registry] = None,
     ) -> None:
         self.config = config or SchedulerConfig()
+        # Exported metrics — the BASELINE north-star (p50 schedule latency)
+        # reads tpu_sched_e2e_duration_seconds; the reference exports nothing
+        # of its own (SURVEY.md §5 "Metrics / observability").
+        self.metrics = metrics or Registry()
+        self._m_cycle = self.metrics.histogram(
+            "tpu_sched_scheduling_cycle_seconds", "One Filter->Permit cycle duration"
+        )
+        self._m_e2e = self.metrics.histogram(
+            "tpu_sched_e2e_duration_seconds", "Cycle start to successful bind"
+        )
+        self._m_attempts = self.metrics.counter(
+            "tpu_sched_attempts_total", "Scheduling attempts by result"
+        )
         self.server = server
         self.descriptor = Descriptor(server)
         self.factory = SharedInformerFactory(server)
@@ -162,13 +179,22 @@ class Scheduler:
         pod = live
 
         state = CycleState()
+        state.write("cycle_start", time.perf_counter())
+        try:
+            self._run_cycle(state, pod)
+        finally:
+            self._m_cycle.observe(time.perf_counter() - state.read("cycle_start"))
+
+    def _run_cycle(self, state: CycleState, pod: Pod) -> None:
         for pl in self.profile.pre_filter:
             st = pl.pre_filter(state, pod)
             if st.code == UNSCHEDULABLE:
                 self._record_failure(pod, f"{pl.name}: {st.message}")
+                self._m_attempts.inc(result="unschedulable")
                 self.queue.add_unschedulable(pod)
                 return
             if not st.ok:
+                self._m_attempts.inc(result="error")
                 self.queue.add_unschedulable(pod)
                 return
 
@@ -190,6 +216,7 @@ class Scheduler:
         if not feasible:
             msg = "; ".join(f"{n}: {r}" for n, r in sorted(reasons.items())) or "no nodes"
             self._record_failure(pod, f"0/{len(snapshot)} nodes available: {msg}")
+            self._m_attempts.inc(result="unschedulable")
             self.queue.add_unschedulable(pod)
             return
 
@@ -270,6 +297,10 @@ class Scheduler:
             return
         self.cache.finish_binding(pod)
         self.queue.done(pod)
+        self._m_attempts.inc(result="scheduled")
+        start = state.read("cycle_start")
+        if start is not None:
+            self._m_e2e.observe(time.perf_counter() - start)
         with self._fail_mu:
             self.failure_reasons.pop(pod.metadata.key, None)
         for pl in self.profile.post_bind:
@@ -280,6 +311,10 @@ class Scheduler:
 
     # -- failure path ------------------------------------------------------
     def _abort_after_assume(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        # Every terminal failure after node selection lands here (reserve/
+        # permit rejection, plugin exception, permit timeout, bind failure),
+        # so the attempts counter can't under-report a retry storm.
+        self._m_attempts.inc(result="error")
         for pl in self.profile.reserve:
             try:
                 pl.unreserve(state, pod, node_name)

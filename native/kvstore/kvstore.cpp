@@ -417,7 +417,10 @@ void replay_aof(const std::string& path) {
 int main(int argc, char** argv) {
   int port = 32767;
   std::string aof_path;
-  std::string bind_addr = "0.0.0.0";  // cluster service: reachable by agents
+  // Loopback by default: an unauthenticated store must not appear on all
+  // interfaces just because someone ran the binary bare. Deploy manifests
+  // pass --bind 0.0.0.0 together with --requirepass.
+  std::string bind_addr = "127.0.0.1";
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
     if (a == "--port" && i + 1 < argc) port = std::stoi(argv[++i]);
@@ -450,6 +453,10 @@ int main(int argc, char** argv) {
   addr.sin_family = AF_INET;
   if (inet_pton(AF_INET, bind_addr.c_str(), &addr.sin_addr) != 1) {
     std::cerr << "bad --bind address: " << bind_addr << "\n";
+    return 1;
+  }
+  if (bind_addr != "127.0.0.1" && g_password.empty()) {
+    std::cerr << "refusing non-loopback --bind without --requirepass\n";
     return 1;
   }
   addr.sin_port = htons(static_cast<uint16_t>(port));
