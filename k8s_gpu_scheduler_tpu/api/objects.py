@@ -29,8 +29,13 @@ TPU_RESOURCE = "google.com/tpu"
 LABEL_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
 LABEL_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
 
+# GKE groups the hosts of one multi-host slice into one node pool.
+LABEL_NODEPOOL = "cloud.google.com/gke-nodepool"
+
 # Our framework's own annotations/labels.
 LABEL_POD_GROUP = "tpu.sched/pod-group"
+LABEL_SLICE_GROUP = "tpu.sched/slice-group"    # falls back to LABEL_NODEPOOL
+LABEL_WORKER_INDEX = "tpu.sched/worker-index"  # host's index within its slice
 ANN_SLICE_CONFIG = "tpu.sched/slice.config"  # analogue of nvidia.com/mig.config
 ANN_RESHAPE_STATE = "tpu.sched/slice.reshape-state"
 

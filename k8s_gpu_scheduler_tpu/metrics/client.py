@@ -141,7 +141,7 @@ class PromClient:
         """Mean MXU duty cycle across a node's chips, 0..100, or None if the
         series is absent — the Score fallback input (the reference computes
         100*(1-GR_ENGINE_ACTIVE) at gpu_plugins.go:508-527)."""
-        samples = self.tpu_metrics_for_node(node_name).get(MXU_DUTY_CYCLE, [])
+        samples = self.instant_query(f'{MXU_DUTY_CYCLE}{{node="{node_name}"}}')
         if not samples:
             return None
         return sum(s.value for s in samples) / len(samples)

@@ -1,0 +1,13 @@
+"""Scheduler plugins — the out-of-tree logic the framework runs.
+
+``tpu``  — chip-accounting Filter, SLO-slack/interference Score with the
+           utilization fallback, Reserve-decided device assignment written in
+           PostBind (the reference's single 930-line plugin, rebuilt
+           side-effect-free: /root/reference/pkg/plugins/gpu_plugin/gpu_plugins.go).
+``gang`` — Permit-based all-or-nothing admission with ICI-topology-aware
+           node-set selection (no reference analogue; SURVEY.md §7.7).
+"""
+from .tpu import TPUPlugin
+from .gang import GangPlugin
+
+__all__ = ["TPUPlugin", "GangPlugin"]

@@ -1,0 +1,312 @@
+"""Gang scheduling — all-or-nothing admission with ICI-aware placement.
+
+The reference has NO gang scheduling: every pod is scored and bound
+independently (SURVEY.md §2 — grep for coscheduling/PodGroup/gang yields
+nothing), which cannot place a multi-host JAX job (a v5p-16 pretrain is 4
+pods that must land together on 4 ICI-connected hosts or not at all). This
+plugin is the flagship new TPU capability (SURVEY.md §7.7, BASELINE config 4):
+
+- Pods opt in with the ``tpu.sched/pod-group`` label naming a ``PodGroup``
+  object (min_member, topology, schedule_timeout_s).
+- **Permit** parks each gang pod as a WaitingPod; when waiting+bound members
+  reach ``min_member``, every parked peer is allowed and the gang binds as a
+  unit. A timeout (or any member's failure) rejects every parked peer, whose
+  cycles then unreserve — chips never leak to a half-placed gang.
+- **Filter/Score** steer members onto hosts of ONE slice (same slice-group
+  label) with minimal added ICI torus diameter, using the worker-index label
+  and the slice shape from ``host_coordinates`` (api/topology.py) — the
+  locality the reference could not express with UUID strings.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..api.objects import (
+    LABEL_NODEPOOL,
+    LABEL_SLICE_GROUP,
+    LABEL_WORKER_INDEX,
+    Pod,
+    PodGroup,
+)
+from ..api.topology import SliceTopology, ici_hop_distance
+from ..sched.cache import NodeInfo
+from ..sched.framework import (
+    CycleState,
+    FilterPlugin,
+    MAX_NODE_SCORE,
+    PermitPlugin,
+    PostBindPlugin,
+    PreFilterPlugin,
+    ReservePlugin,
+    ScorePlugin,
+    Status,
+)
+from .tpu import ENV_WORKER_HOSTNAMES, ENV_WORKER_ID
+
+log = logging.getLogger(__name__)
+
+
+def slice_group_of(info: NodeInfo) -> str:
+    labels = info.node.metadata.labels
+    return labels.get(LABEL_SLICE_GROUP) or labels.get(LABEL_NODEPOOL) or ""
+
+
+def worker_index_of(info: NodeInfo) -> int:
+    try:
+        return int(info.node.metadata.labels.get(LABEL_WORKER_INDEX, "0"))
+    except ValueError:
+        return 0
+
+
+class GangPlugin(
+    PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin, PermitPlugin, PostBindPlugin
+):
+    name = "Gang"
+    weight = 1.0
+
+    def __init__(self, handle) -> None:
+        self.handle = handle
+        self._mu = threading.Lock()
+        # group key -> {pod uid -> node name}, reserved-but-not-yet-confirmed
+        # AND bound members (pruned when the pod or group is deleted).
+        self._assignments: Dict[str, Dict[str, str]] = {}
+        # Prune bookkeeping when gang members disappear, so a re-created
+        # gang under the same name starts from a clean count.
+        self.handle.factory.informer("Pod").add_event_handler(
+            on_delete=self._on_pod_delete
+        )
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        name = pod.pod_group()
+        if not name:
+            return
+        key = f"{pod.metadata.namespace}/{name}"
+        with self._mu:
+            members = self._assignments.get(key, {})
+            members.pop(pod.metadata.uid, None)
+            if not members:
+                self._assignments.pop(key, None)
+
+    # -- group lookup ------------------------------------------------------
+    def _group_of(self, pod: Pod) -> Optional[PodGroup]:
+        name = pod.pod_group()
+        if not name:
+            return None
+        try:
+            return self.handle.descriptor.server.get(
+                "PodGroup", name, pod.metadata.namespace
+            )
+        except Exception:  # noqa: BLE001 — NotFound
+            return None
+
+    # -- PreFilter ---------------------------------------------------------
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        name = pod.pod_group()
+        if not name:
+            return Status.success()
+        group = self._group_of(pod)
+        if group is None:
+            return Status.unschedulable(f"pod group {name!r} not found")
+        state.write("gang.group", group)
+        # Early total-capacity check so a gang that can never fit doesn't
+        # assume chips pod by pod and thrash the cluster.
+        chips = pod.spec.tpu_chips()
+        if chips > 0:
+            free_hosts = sum(
+                1
+                for info in self.handle.cache.snapshot().values()
+                if info.free_tpu >= chips
+            )
+            with self._mu:
+                already = len(self._assignments.get(self._key(group), {}))
+            if free_hosts + already < group.min_member:
+                return Status.unschedulable(
+                    f"gang {name}: {free_hosts} candidate hosts + {already} "
+                    f"reserved < min_member {group.min_member}"
+                )
+        return Status.success()
+
+    @staticmethod
+    def _key(group: PodGroup) -> str:
+        return group.metadata.key
+
+    # -- Filter ------------------------------------------------------------
+    def filter(self, state: CycleState, pod: Pod, info: NodeInfo) -> Status:
+        group: Optional[PodGroup] = state.read("gang.group")
+        if group is None:
+            return Status.success()
+        with self._mu:
+            assigned = dict(self._assignments.get(self._key(group), {}))
+        # One gang member per host — a multi-host JAX job runs exactly one
+        # worker process per TPU VM.
+        if info.name in assigned.values():
+            return Status.unschedulable("host already holds a gang peer")
+        if group.topology:
+            topo = info.slice_topology()
+            if topo is None:
+                return Status.unschedulable("node missing TPU topology labels")
+            want = SliceTopology.parse(topo.gen, group.topology)
+            if topo.dims != want.dims:
+                return Status.unschedulable(
+                    f"slice shape {topo.dims} != gang topology {want.dims}"
+                )
+        # All members ride one slice's ICI: once any member is reserved, the
+        # rest must share its slice group.
+        if assigned:
+            peer_groups = state.read("gang.peer_slice_groups")
+            if peer_groups is None:
+                peer_groups = self._slice_groups_of_nodes(set(assigned.values()))
+                state.write("gang.peer_slice_groups", peer_groups)
+            mine = slice_group_of(info)
+            if peer_groups and mine not in peer_groups:
+                return Status.unschedulable(
+                    f"slice group {mine!r} differs from gang's {sorted(peer_groups)}"
+                )
+        return Status.success()
+
+    def _slice_groups_of_nodes(self, node_names) -> set:
+        groups = set()
+        for info in self.handle.cache.snapshot().values():
+            if info.name in node_names:
+                g = slice_group_of(info)
+                if g:
+                    groups.add(g)
+        return groups
+
+    # -- Score -------------------------------------------------------------
+    def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[float, Status]:
+        group: Optional[PodGroup] = state.read("gang.group")
+        if group is None:
+            return 0.0, Status.success()
+        info: Optional[NodeInfo] = state.read(f"tpu.nodeinfo/{node_name}")
+        if info is None:
+            return 0.0, Status.success()
+        topo = info.slice_topology()
+        if topo is None:
+            return 0.0, Status.success()
+        with self._mu:
+            assigned = dict(self._assignments.get(self._key(group), {}))
+        if not assigned:
+            # First member: prefer low worker indices so gangs pack from the
+            # slice origin and leave contiguous room for the next gang.
+            return float(MAX_NODE_SCORE - min(worker_index_of(info), MAX_NODE_SCORE)), Status.success()
+        # Later members: minimize added ICI hops to the reserved peers.
+        try:
+            coords = topo.gen and self._host_coords(topo)
+        except ValueError:
+            return 0.0, Status.success()
+        peers = self._peer_indices(assigned)
+        mine = worker_index_of(info)
+        if mine >= len(coords) or any(p >= len(coords) for p in peers):
+            return 0.0, Status.success()
+        wrap = topo.has_wraparound
+        added = sum(
+            ici_hop_distance(coords[mine], coords[p], topo.dims, wrap=wrap)
+            for p in peers
+        )
+        worst = (sum(topo.dims)) * max(len(peers), 1)
+        return max(0.0, MAX_NODE_SCORE * (1.0 - added / max(worst, 1))), Status.success()
+
+    @staticmethod
+    def _host_coords(topo: SliceTopology) -> List[Tuple[int, ...]]:
+        from ..api.topology import host_coordinates
+
+        return host_coordinates(topo.dims, topo.gen)
+
+    def _peer_indices(self, assigned: Dict[str, str]) -> List[int]:
+        out = []
+        for info in self.handle.cache.snapshot().values():
+            if info.name in assigned.values():
+                out.append(worker_index_of(info))
+        return out
+
+    # -- Reserve -----------------------------------------------------------
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        group: Optional[PodGroup] = state.read("gang.group")
+        if group is None:
+            return Status.success()
+        with self._mu:
+            members = self._assignments.setdefault(self._key(group), {})
+            members[pod.metadata.uid] = node_name
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        group: Optional[PodGroup] = state.read("gang.group")
+        if group is None:
+            return
+        key = self._key(group)
+        with self._mu:
+            members = self._assignments.get(key, {})
+            members.pop(pod.metadata.uid, None)
+            if not members:
+                self._assignments.pop(key, None)
+        # All-or-nothing: one member's failure collapses the whole gang —
+        # reject every parked peer so their cycles unreserve too.
+        self._reject_gang(key, f"gang peer {pod.metadata.name} failed")
+
+    def _reject_gang(self, group_key: str, reason: str) -> None:
+        def maybe_reject(wp) -> None:
+            g = wp.pod.pod_group()
+            if g and f"{wp.pod.metadata.namespace}/{g}" == group_key:
+                wp.reject(reason)
+
+        self.handle.iterate_waiting_pods(maybe_reject)
+
+    # -- Permit ------------------------------------------------------------
+    def permit(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[Status, float]:
+        group: Optional[PodGroup] = state.read("gang.group")
+        if group is None:
+            return Status.success(), 0.0
+        key = self._key(group)
+        # Members already through Reserve (this pod included).
+        with self._mu:
+            reserved = len(self._assignments.get(key, {}))
+        if reserved >= group.min_member:
+            # Quorum: release every parked peer, proceed ourselves.
+            def allow(wp) -> None:
+                g = wp.pod.pod_group()
+                if g and f"{wp.pod.metadata.namespace}/{g}" == key:
+                    wp.allow(self.name)
+
+            self.handle.iterate_waiting_pods(allow)
+            log.info("gang %s reached quorum (%d/%d) — admitting",
+                     key, reserved, group.min_member)
+            return Status.success(), 0.0
+        return Status.wait(
+            f"gang {key}: {reserved}/{group.min_member} members reserved"
+        ), group.schedule_timeout_s
+
+    # -- PostBind ----------------------------------------------------------
+    def post_bind(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        """Write the distributed-runtime env: this worker's id and every
+        member's host — what jax.distributed.initialize needs
+        (coordinator = worker 0). Overrides the single-host values the TPU
+        plugin wrote (profile order puts Gang after TPU)."""
+        group: Optional[PodGroup] = state.read("gang.group")
+        if group is None:
+            return
+        with self._mu:
+            assigned = dict(self._assignments.get(self._key(group), {}))
+        if not assigned:
+            return
+        # Deterministic worker ids: sort members' hosts by worker-index label
+        # (falling back to node name) so every member derives the same order.
+        infos = {i.name: i for i in self.handle.cache.snapshot().values()}
+        hosts = sorted(
+            set(assigned.values()),
+            key=lambda n: (worker_index_of(infos[n]) if n in infos else 0, n),
+        )
+        try:
+            my_id = hosts.index(node_name)
+        except ValueError:
+            my_id = 0
+        self.handle.descriptor.append_to_pod_configmaps(
+            pod,
+            {
+                ENV_WORKER_ID: str(my_id),
+                ENV_WORKER_HOSTNAMES: ",".join(hosts),
+                "TPU_WORKER_COUNT": str(len(hosts)),
+            },
+        )

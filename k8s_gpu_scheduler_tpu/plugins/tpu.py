@@ -1,0 +1,541 @@
+"""The TPU scheduler plugin — Filter/Score/Reserve/PostBind.
+
+TPU-native rebuild of the reference's single GPU plugin
+(/root/reference/pkg/plugins/gpu_plugin/gpu_plugins.go:455-930). Behavior
+parity, re-architected:
+
+- The assignable unit is a *sub-slice partition* of a host's board (the MIG
+  instance analogue, SLICE_CONFIGS in api/topology.py), identified by a
+  partition key instead of a GPU UUID string.
+- Score is SIDE-EFFECT-FREE. The reference writes ConfigMaps while scoring
+  (gpu_plugins.go:653-666,760-772) so the last-scored node's writes win even
+  for nodes that lose — SURVEY.md §3.2 flags this as a correctness hazard.
+  Here every decision is stashed in CycleState during Score, adopted by
+  Reserve for the winning node only, and written to the cluster in PostBind.
+- The SLO-slack/interference formula is exact parity (gpu_plugins.go:616-622,
+  727-733): slack = SLO - (predicted_qps - interference), violated SLOs
+  accumulate 1/(1+(|slack/SLO|+1)^2), satisfied ones 1/(1+|slack/SLO|), and
+  the partition score is 100*((1-k)*pos_avg + k*neg_avg) with
+  k = neg_count/(neg_count+pos_count).
+- The no-registry fallback scores 100*(1-utilization) from the metrics layer
+  (parity :508-527 — except the reference then returns 0 regardless, a bug
+  we do not reproduce).
+- Right-sizing parity (:638-666): for shareable hosts the plugin picks the
+  cheapest partitioning whose predicted QPS still meets the pod's SLO and
+  records it for PostBind (the MPS_<node> ConfigMap key analogue) — but the
+  write happens post-bind, not mid-score.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol, Tuple
+
+from ..api.objects import Pod
+from ..api.topology import SliceTopology, TPUGen, chip_count, parse_topology
+from ..registry.inventory import NodeInventory, read_inventory
+from ..sched.cache import NodeInfo
+from ..sched.framework import (
+    CycleState,
+    FilterPlugin,
+    MAX_NODE_SCORE,
+    MIN_NODE_SCORE,
+    PostBindPlugin,
+    PreFilterPlugin,
+    ReservePlugin,
+    ScorePlugin,
+    Status,
+)
+
+log = logging.getLogger(__name__)
+
+# ConfigMap/env keys injected at PostBind — the CUDA_VISIBLE_DEVICES /
+# CUDA_MPS_* analogues (gpu_plugins.go:910-920) in GKE-TPU vocabulary.
+ENV_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
+ENV_WORKER_ID = "TPU_WORKER_ID"
+ENV_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_TOPOLOGY = "TPU_TOPOLOGY"
+ENV_ACCELERATOR = "TPU_ACCELERATOR_TYPE"
+ENV_HBM_LIMIT = "TPU_HBM_LIMIT_BYTES"
+ENV_DUTY_PCT = "TPU_DUTY_CYCLE_PERCENTAGE"
+ENV_SLO = "SLO"
+
+_GEN_SHORT = {TPUGen.V5E: "V5E", TPUGen.V6E: "V6E", TPUGen.V5P: "V5P", TPUGen.V4: "V4"}
+
+
+def gen_short(gen: TPUGen) -> str:
+    return _GEN_SHORT[gen]
+
+
+class PredictionClient(Protocol):
+    """What the plugin needs from the recommender (C8 parity —
+    go_client/pkg/client_call.go:11-37). Implementations: the gRPC client in
+    recommender/client.py; tests inject an in-memory fake."""
+
+    def impute_configurations(self, index: str) -> Dict[str, float]: ...
+
+    def impute_interference(self, index: str) -> Dict[str, float]: ...
+
+
+class InventorySource(Protocol):
+    """Registry read seam (redis Get(nodeName) analogue, gpu_plugins.go:536)."""
+
+    def get(self, key: str) -> Optional[str]: ...
+
+
+@dataclass
+class Partition:
+    """One assignable sub-slice of a host board (the MIG-instance analogue)."""
+
+    key: str              # e.g. "part-0/2x2"
+    topology: str         # sub-slice shape, e.g. "2x2"
+    chip_ids: List[int]   # device ids owned by this partition
+
+
+@dataclass
+class Decision:
+    """What Score decided for one node; Reserve adopts the winner's, PostBind
+    writes it. Replaces the reference's mid-score ConfigMap side channel."""
+
+    node_name: str
+    partition: Optional[Partition] = None
+    # Right-sized partitioning chosen for the pod (MPS_<node> analogue),
+    # e.g. "2x2" meaning: this pod is happy with a quarter board.
+    rightsized_config: str = ""
+    worker_id: int = 0
+    hostnames: List[str] = field(default_factory=list)
+    accelerator: str = ""
+    hbm_limit_bytes: int = 0
+    duty_pct: int = 100
+
+
+def pod_slo(pod: Pod) -> float:
+    """Parse the pod's SLO env (QPS target) — parity with the tolerant parse
+    at gpu_plugins.go:460-469 (unset/garbage → 0)."""
+    raw = pod.get_env(ENV_SLO)
+    if not raw:
+        return 0.0
+    try:
+        return float(raw)
+    except ValueError:
+        return 0.0
+
+
+def slo_slack_terms(slo: float, predicted: float, interference: float) -> Tuple[float, bool]:
+    """One pod's contribution to the partition score (gpu_plugins.go:616-622).
+
+    Returns (term, violated): violated pods feed negative_sum with a
+    quadratically-penalized term; satisfied pods feed positive_sum.
+    """
+    slack = slo - (predicted - interference)
+    rel = abs(slack / slo)
+    if slo > predicted - interference:
+        return 1.0 / (1.0 + (rel + 1.0) ** 2), True
+    return 1.0 / (1.0 + rel), False
+
+
+def combine_terms(pos_sum: float, pos_n: int, neg_sum: float, neg_n: int) -> float:
+    """Blend satisfied/violated contributions (gpu_plugins.go:676-688)."""
+    if pos_n and neg_n:
+        k = neg_n / (neg_n + pos_n)
+        return 100.0 * ((1 - k) * pos_sum / pos_n + k * neg_sum / neg_n)
+    if neg_n:
+        return 100.0 * neg_sum / neg_n
+    if pos_n:
+        return 100.0 * pos_sum / pos_n
+    return 0.0
+
+
+def match_interference(interference: Dict[str, float], pod_name: str) -> float:
+    """First row of the interference reply whose key is a substring of the
+    (normalized) pod name — parity with the '-'→'_' substring match at
+    gpu_plugins.go:595-612."""
+    normalized = pod_name.replace("-", "_")
+    for key, val in interference.items():
+        if key in normalized:
+            return val
+    return 0.0
+
+
+class TPUPlugin(
+    PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin, PostBindPlugin
+):
+    """The plugin. Construction mirrors New(_, handle) (gpu_plugins.go:928):
+    everything it touches arrives via the Handle plus two injected clients."""
+
+    name = "TPU"
+
+    def __init__(
+        self,
+        handle,
+        registry: Optional[InventorySource] = None,
+        prom=None,
+        recommender: Optional[PredictionClient] = None,
+    ) -> None:
+        self.handle = handle
+        self.registry = registry
+        self.prom = prom
+        self.recommender = recommender
+        self.weight = handle.config.tpu_score_weight
+
+    # -- PreFilter ---------------------------------------------------------
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        chips = pod.spec.tpu_chips()
+        if chips < 0:
+            return Status.unschedulable("negative TPU request")
+        state.write("tpu.request", chips)
+        state.write("tpu.slo", pod_slo(pod))
+        return Status.success()
+
+    # -- Filter ------------------------------------------------------------
+    def filter(self, state: CycleState, pod: Pod, info: NodeInfo) -> Status:
+        # node_selector must match (the reference encodes GPU model in the
+        # node NAME and substring-matches it, gpu_plugins.go:478-499; we use
+        # labels, the GKE-native mechanism).
+        for k, v in pod.spec.node_selector.items():
+            if info.node.metadata.labels.get(k) != v:
+                return Status.unschedulable(f"node selector {k}={v} not matched")
+        if "Ready" not in info.node.status.conditions:
+            return Status.unschedulable("node not Ready")
+        chips = pod.spec.tpu_chips()
+        if chips == 0:
+            # CPU-only pod (busybox smoke, BASELINE config 1) — any Ready
+            # node that matches the selector will do.
+            state.write(f"tpu.nodeinfo/{info.name}", info)
+            return Status.success()
+        if info.allocatable_tpu == 0:
+            return Status.unschedulable("node has no TPUs")
+        if info.free_tpu < chips:
+            return Status.unschedulable(
+                f"insufficient TPU chips: want {chips}, free {info.free_tpu}"
+            )
+        topo = info.slice_topology()
+        if topo is None:
+            return Status.unschedulable("node missing TPU accelerator/topology labels")
+        if chips > topo.chips:
+            return Status.unschedulable(
+                f"request {chips} exceeds slice size {topo.chips}"
+            )
+        state.write(f"tpu.nodeinfo/{info.name}", info)
+        return Status.success()
+
+    # -- Score -------------------------------------------------------------
+    def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[float, Status]:
+        try:
+            decision, raw = self._decide(state, pod, node_name)
+        except Exception as e:  # noqa: BLE001 — a scoring dependency down ≠ cycle abort
+            log.warning("score(%s) degraded: %s", node_name, e)
+            return 0.0, Status.success()
+        state.write(f"tpu.decision/{node_name}", decision)
+        return raw, Status.success()
+
+    def normalize_scores(self, state: CycleState, pod: Pod, scores: Dict[str, float]) -> Status:
+        """Min-max rescale to [MIN,MAX] — parity NormalizeScore
+        (gpu_plugins.go:816-841)."""
+        if not scores:
+            return Status.success()
+        lo, hi = min(scores.values()), max(scores.values())
+        if hi == lo:
+            for k in scores:
+                scores[k] = float(MAX_NODE_SCORE)
+            return Status.success()
+        span = MAX_NODE_SCORE - MIN_NODE_SCORE
+        for k, v in scores.items():
+            scores[k] = MIN_NODE_SCORE + span * (v - lo) / (hi - lo)
+        return Status.success()
+
+    # -- Reserve -----------------------------------------------------------
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        decision = state.read(f"tpu.decision/{node_name}")
+        if decision is None:
+            # Score was skipped (single feasible node) — decide now.
+            try:
+                decision, _ = self._decide(state, pod, node_name)
+            except Exception as e:  # noqa: BLE001
+                log.warning("reserve-time decide(%s) degraded: %s", node_name, e)
+                decision = Decision(node_name=node_name)
+        state.write("tpu.reserved", decision)
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        state.write("tpu.reserved", None)
+
+    # -- PostBind ----------------------------------------------------------
+    def post_bind(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        """Inject the device assignment through the pod's EnvFrom ConfigMaps —
+        the mechanism of gpu_plugins.go:843-920 (kubelet resolves EnvFrom at
+        container start, after this write)."""
+        decision: Optional[Decision] = state.read("tpu.reserved")
+        if decision is None or decision.node_name != node_name:
+            decision = Decision(node_name=node_name)
+        data: Dict[str, str] = {}
+        if decision.partition is not None:
+            part = decision.partition
+            data[ENV_VISIBLE_CHIPS] = ",".join(str(i) for i in part.chip_ids)
+            data[ENV_TOPOLOGY] = part.topology
+            # {nodeName: selectedUUID} parity (gpu_plugins.go:760-772) so
+            # GetSLOs-style reverse lookups can attribute pods to partitions.
+            data[node_name] = part.key
+        if decision.accelerator:
+            data[ENV_ACCELERATOR] = decision.accelerator
+        if decision.rightsized_config:
+            # MPS_<node> analogue (gpu_plugins.go:653-666).
+            data[f"RIGHTSIZE_{node_name}"] = decision.rightsized_config
+        if decision.hbm_limit_bytes:
+            # CUDA_MPS_PINNED_DEVICE_MEM_LIMIT / ACTIVE_THREAD_PERCENTAGE
+            # analogues (gpu_plugins.go:896-904).
+            data[ENV_HBM_LIMIT] = str(decision.hbm_limit_bytes)
+            data[ENV_DUTY_PCT] = str(decision.duty_pct)
+        data[ENV_WORKER_ID] = str(decision.worker_id)
+        if decision.hostnames:
+            data[ENV_WORKER_HOSTNAMES] = ",".join(decision.hostnames)
+        written = self.handle.descriptor.append_to_pod_configmaps(pod, data)
+        if not written:
+            log.info("pod %s has no EnvFrom ConfigMap; assignment not injected",
+                     pod.metadata.key)
+
+    # -- decision core -----------------------------------------------------
+    def _decide(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[Decision, float]:
+        """Compute (decision, raw_score) for one node. Pure read-only."""
+        info: Optional[NodeInfo] = state.read(f"tpu.nodeinfo/{node_name}")
+        if info is None:
+            for name, i in self.handle.cache.snapshot().items():
+                if name == node_name:
+                    info = i
+                    break
+        if info is None:
+            return Decision(node_name=node_name), 0.0
+
+        chips_wanted = pod.spec.tpu_chips()
+        topo = info.slice_topology()
+        if chips_wanted == 0 or topo is None:
+            # CPU pod or unlabeled node: score by inverse utilization only.
+            return Decision(node_name=node_name), self._utilization_score(node_name)
+
+        inv = self._inventory(node_name)
+        partitions = self._partitions(info, topo, inv)
+        slo = state.read("tpu.slo") or pod_slo(pod)
+
+        if inv is None and self.registry is not None:
+            # Registry reachable but node unpublished — conservative parity
+            # with the no-registry DCGM fallback (gpu_plugins.go:508-527).
+            decision = Decision(node_name=node_name, accelerator=topo.gen.value)
+            decision.partition = self._pick_free_partition(info, partitions, chips_wanted)
+            return decision, self._utilization_score(node_name, inv)
+
+        decision = Decision(node_name=node_name, accelerator=topo.gen.value)
+        if slo <= 0 or self.recommender is None:
+            # No SLO or no predictor: inverse-utilization score, first
+            # fitting partition.
+            decision.partition = self._pick_free_partition(info, partitions, chips_wanted)
+            self._fill_sharing_limits(decision, topo, partitions)
+            return decision, self._utilization_score(node_name, inv=inv)
+
+        score, best = self._slo_score(info, topo, partitions, pod, slo, chips_wanted)
+        decision.partition = best or self._pick_free_partition(info, partitions, chips_wanted)
+        decision.rightsized_config = self._rightsize(topo, slo)
+        self._fill_sharing_limits(decision, topo, partitions)
+        return decision, score
+
+    def _slo_score(
+        self,
+        info: NodeInfo,
+        topo: SliceTopology,
+        partitions: List[Partition],
+        pod: Pod,
+        slo: float,
+        chips_wanted: int,
+    ) -> Tuple[float, Optional[Partition]]:
+        """The hot loop (gpu_plugins.go:561-756): for every partition, blend
+        SLO slack of already-placed pods and of the incoming pod; argmax."""
+        assert self.recommender is not None
+        gen = gen_short(topo.gen)
+        parts_count = max(len(partitions), 1)
+        conf_index = f"{parts_count}P_{gen}"
+        placed = self._placed_slos(info, partitions)
+
+        best_score, best_part = float(MIN_NODE_SCORE), None
+        incoming_conf = self.recommender.impute_configurations(pod.metadata.name)
+        incoming_intf = self.recommender.impute_interference(
+            f"{pod.metadata.name}_{gen}"
+        )
+        for part in partitions:
+            if len(part.chip_ids) < chips_wanted:
+                continue
+            pos_sum, neg_sum, pos_n, neg_n = 0.0, 0.0, 0, 0
+            co_located = placed.get(part.key, {})
+            for other_name, other_slo in co_located.items():
+                if other_slo <= 0:
+                    continue
+                conf = self.recommender.impute_configurations(other_name).get(conf_index)
+                if conf is None:
+                    continue
+                intf_row = self.recommender.impute_interference(f"{other_name}_{gen}")
+                intf = sum(
+                    match_interference(intf_row, third)
+                    for third in co_located
+                    if third != other_name
+                )
+                intf += match_interference(intf_row, pod.metadata.name)
+                term, violated = slo_slack_terms(other_slo, conf, intf)
+                if violated:
+                    neg_sum += term
+                    neg_n += 1
+                else:
+                    pos_sum += term
+                    pos_n += 1
+
+            conf = incoming_conf.get(conf_index)
+            if conf is not None:
+                intf = sum(
+                    match_interference(incoming_intf, third) for third in co_located
+                )
+                term, violated = slo_slack_terms(slo, conf, intf)
+                if violated:
+                    neg_sum += term
+                    neg_n += 1
+                else:
+                    pos_sum += term
+                    pos_n += 1
+
+            part_score = combine_terms(pos_sum, pos_n, neg_sum, neg_n)
+            if part_score > best_score:
+                best_score, best_part = part_score, part
+        return best_score, best_part
+
+    def _rightsize(self, topo: SliceTopology, slo: float) -> str:
+        """Cheapest partitioning that still meets the SLO — V100/MPS
+        right-sizing parity (gpu_plugins.go:638-666), smallest sub-slice
+        preferred (the reference prefers the *lowest predicted QPS* that
+        still clears the SLO)."""
+        if self.recommender is None:
+            return ""
+        from ..api.topology import SLICE_CONFIGS
+
+        gen = gen_short(topo.gen)
+        best_cfg, best_pred = "", -1.0
+        for cfg, parts in SLICE_CONFIGS[topo.gen]:
+            preds = self.recommender.impute_configurations(cfg)
+            pred = preds.get(f"{parts}P_{gen}")
+            if pred is None:
+                continue
+            if pred > slo and (best_pred < 0 or pred < best_pred):
+                best_cfg, best_pred = cfg, pred
+        return best_cfg
+
+    # -- partition / inventory helpers ------------------------------------
+    def _inventory(self, node_name: str) -> Optional[NodeInventory]:
+        if self.registry is None:
+            return None
+        try:
+            return read_inventory(self.registry, node_name)
+        except Exception:  # noqa: BLE001 — registry down = degrade, don't abort
+            return None
+
+    def _partitions(
+        self, info: NodeInfo, topo: SliceTopology, inv: Optional[NodeInventory]
+    ) -> List[Partition]:
+        """Carve the host board into assignable partitions according to the
+        node's current slice config annotation (the nvidia.com/mig.config
+        analogue) — default one whole-board partition."""
+        from ..api.objects import ANN_SLICE_CONFIG
+
+        total = topo.gen.chips_per_host if topo.is_multi_host else topo.chips
+        cfg = info.node.metadata.annotations.get(ANN_SLICE_CONFIG, "")
+        if cfg:
+            try:
+                per = chip_count(parse_topology(cfg))
+            except ValueError:
+                per = total
+        else:
+            cfg = info.node.tpu_topology() or ""
+            per = total
+        per = max(1, min(per, total))
+        count = total // per
+        return [
+            Partition(
+                key=f"part-{i}/{cfg}",
+                topology=cfg,
+                chip_ids=list(range(i * per, (i + 1) * per)),
+            )
+            for i in range(count)
+        ]
+
+    def _placed_slos(
+        self, info: NodeInfo, partitions: List[Partition]
+    ) -> Dict[str, Dict[str, float]]:
+        """partition key → {pod name → SLO} for pods already on the node —
+        GetSLOs parity (gpu_plugins.go:87-160), reading each pod's EnvFrom
+        ConfigMap back for its assigned partition."""
+        out: Dict[str, Dict[str, float]] = {}
+        for p in info.pods:
+            if p.spec.tpu_chips() == 0:
+                continue
+            key = self._assigned_partition(p, info.name)
+            if key is None:
+                # Not yet injected — attribute to the first partition so its
+                # capacity still counts (conservative).
+                key = partitions[0].key if partitions else ""
+            out.setdefault(key, {})[p.metadata.name] = pod_slo(p)
+        return out
+
+    def _assigned_partition(self, pod: Pod, node_name: str) -> Optional[str]:
+        for c in pod.spec.containers:
+            for ref in c.env_from:
+                try:
+                    cm = self.handle.descriptor.get_configmap(
+                        ref.name, pod.metadata.namespace
+                    )
+                except Exception:  # noqa: BLE001 — NotFound or API hiccup
+                    continue
+                if node_name in cm.data:
+                    return cm.data[node_name]
+        return None
+
+    def _pick_free_partition(
+        self, info: NodeInfo, partitions: List[Partition], chips_wanted: int
+    ) -> Optional[Partition]:
+        """First partition with enough chips and the fewest pods already
+        attributed to it (deterministic; the reference shuffles UUIDs at
+        gpu_plugins.go:561 — determinism makes hermetic tests exact)."""
+        if not partitions:
+            return None
+        placed = self._placed_slos(info, partitions)
+        eligible = [p for p in partitions if len(p.chip_ids) >= chips_wanted]
+        if not eligible:
+            return None
+        return min(eligible, key=lambda p: (len(placed.get(p.key, {})), p.key))
+
+    def _fill_sharing_limits(
+        self, decision: Decision, topo: SliceTopology, partitions: List[Partition]
+    ) -> None:
+        """HBM/duty caps when the host is shared — the MPS-limit analogue
+        (gpu_plugins.go:896-904: 2 partitions → half memory/50%, 4 → quarter/25%)."""
+        n = len(partitions)
+        if n <= 1:
+            return
+        per_chip_hbm = int(topo.gen.hbm_gib * (1 << 30))
+        chips = len(decision.partition.chip_ids) if decision.partition else 1
+        decision.hbm_limit_bytes = per_chip_hbm * chips
+        decision.duty_pct = max(1, 100 // n)
+
+    _UNFETCHED = object()  # sentinel: caller hasn't consulted the registry
+
+    def _utilization_score(self, node_name: str, inv=_UNFETCHED) -> float:
+        """100*(1-utilization) — the DCGM_FI_PROF_GR_ENGINE_ACTIVE fallback
+        (gpu_plugins.go:508-527). Prefers the agent-published inventory
+        (0..1), then the Prometheus duty-cycle series (0..100), then neutral
+        0. Callers that already read the registry pass their result (possibly
+        None) to avoid a second roundtrip."""
+        if inv is TPUPlugin._UNFETCHED:
+            inv = self._inventory(node_name)
+        if inv is not None:
+            return 100.0 * (1.0 - max(0.0, min(1.0, inv.utilization)))
+        if self.prom is not None:
+            try:
+                duty_pct = self.prom.node_duty_cycle(node_name)
+            except Exception:  # noqa: BLE001
+                duty_pct = None
+            if duty_pct is not None:
+                return 100.0 - max(0.0, min(100.0, duty_pct))
+        return 0.0

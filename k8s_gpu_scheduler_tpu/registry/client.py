@@ -146,7 +146,7 @@ class Client:
                 self._connect()
             try:
                 return self._roundtrip_locked(list(argv))
-            except (OSError, ConnectionLost):
+            except (OSError, ConnectionLost) as transport_err:
                 # Transport died (server restarted, idle timeout). Drop the
                 # socket; transparently retry only idempotent commands —
                 # a -ERR reply never lands here (the server DID answer).
@@ -156,7 +156,9 @@ class Client:
                 finally:
                     self._sock = None
                 if argv[0].upper() not in _IDEMPOTENT:
-                    raise ConnectionLost(f"{argv[0]} failed mid-flight (not retried)") from None
+                    raise ConnectionLost(
+                        f"{argv[0]} failed mid-flight (not retried)"
+                    ) from transport_err
                 self._connect()
                 return self._roundtrip_locked(list(argv))
 

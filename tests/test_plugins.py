@@ -1,0 +1,577 @@
+"""TPU plugin + gang plugin tests.
+
+The reference's 930-line plugin has ZERO tests (SURVEY.md §4); this suite is
+the hermetic coverage the rebuild owes: scoring-formula parity, the
+no-registry fallback, side-effect-free Score (losing nodes get no writes —
+the reference's hazard at gpu_plugins.go:653-666,760-772), device-assignment
+injection, and all-or-nothing gang admission (no reference analogue).
+"""
+import time
+
+import pytest
+
+from k8s_gpu_scheduler_tpu.api.objects import (
+    ANN_SLICE_CONFIG,
+    ConfigMap,
+    ConfigMapRef,
+    Container,
+    EnvVar,
+    LABEL_POD_GROUP,
+    LABEL_SLICE_GROUP,
+    LABEL_TPU_ACCELERATOR,
+    LABEL_TPU_TOPOLOGY,
+    LABEL_WORKER_INDEX,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodSpec,
+    ResourceRequirements,
+    TPU_RESOURCE,
+)
+from k8s_gpu_scheduler_tpu.cluster import APIServer
+from k8s_gpu_scheduler_tpu.config import SchedulerConfig
+from k8s_gpu_scheduler_tpu.plugins import GangPlugin, TPUPlugin
+from k8s_gpu_scheduler_tpu.plugins.tpu import (
+    ENV_VISIBLE_CHIPS,
+    ENV_WORKER_HOSTNAMES,
+    ENV_WORKER_ID,
+    combine_terms,
+    match_interference,
+    pod_slo,
+    slo_slack_terms,
+)
+from k8s_gpu_scheduler_tpu.registry.inventory import NodeInventory, node_key
+from k8s_gpu_scheduler_tpu.sched import CycleState, Profile, Scheduler, Status
+
+
+# --- builders ----------------------------------------------------------------
+
+
+def mk_node(name, chips=8, gen="tpu-v5-lite-podslice", topo="2x4", labels=None,
+            annotations=None):
+    lab = {LABEL_TPU_ACCELERATOR: gen, LABEL_TPU_TOPOLOGY: topo}
+    lab.update(labels or {})
+    return Node(
+        metadata=ObjectMeta(name=name, labels=lab, annotations=annotations or {}),
+        status=NodeStatus(
+            capacity={TPU_RESOURCE: chips},
+            allocatable={TPU_RESOURCE: chips},
+            addresses=["10.0.0.1"],
+        ),
+    )
+
+
+def mk_pod(name, chips=1, slo=None, cm=None, group=None, ns="default"):
+    env = [EnvVar("SLO", str(slo))] if slo is not None else []
+    env_from = [ConfigMapRef(cm)] if cm else []
+    labels = {LABEL_POD_GROUP: group} if group else {}
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=labels),
+        spec=PodSpec(
+            containers=[
+                Container(
+                    env=env,
+                    env_from=env_from,
+                    resources=ResourceRequirements(requests={TPU_RESOURCE: chips}),
+                )
+            ]
+        ),
+    )
+
+
+class FakeRegistry:
+    """In-memory stand-in for the RESP client (registry/client.py)."""
+
+    def __init__(self):
+        self.data = {}
+
+    def get(self, key):
+        return self.data.get(key)
+
+    def set(self, key, value):
+        self.data[key] = value
+
+    def get_keys(self, pattern="*"):
+        prefix = pattern.rstrip("*")
+        return [k for k in self.data if k.startswith(prefix)]
+
+    def publish(self, node_name, utilization=0.0):
+        inv = NodeInventory(node_name=node_name, utilization=utilization)
+        self.data[node_key(node_name)] = inv.to_json()
+
+
+class FakeRecommender:
+    """PredictionClient fake — canned conf/interference matrices."""
+
+    def __init__(self, conf=None, intf=None):
+        self.conf = conf or {}
+        self.intf = intf or {}
+
+    def impute_configurations(self, index):
+        for key, row in self.conf.items():
+            if key in index.replace("-", "_"):
+                return row
+        return {}
+
+    def impute_interference(self, index):
+        for key, row in self.intf.items():
+            if key in index.replace("-", "_"):
+                return row
+        return {}
+
+
+def wait_until(fn, timeout=5.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_scheduler(server, registry=None, recommender=None, config=None,
+                   with_gang=False):
+    config = config or SchedulerConfig(backoff_initial_s=0.05, backoff_max_s=0.2)
+    sched = Scheduler(server, profile=Profile(), config=config)
+    tpu = TPUPlugin(sched.handle, registry=registry, recommender=recommender)
+    profile = Profile(
+        pre_filter=[tpu], filter=[tpu], score=[tpu], reserve=[tpu], post_bind=[tpu]
+    )
+    if with_gang:
+        gang = GangPlugin(sched.handle)
+        profile.pre_filter.append(gang)
+        profile.filter.append(gang)
+        profile.score.append(gang)
+        profile.reserve.append(gang)
+        profile.permit.append(gang)
+        profile.post_bind.append(gang)
+    sched.profile = profile
+    return sched
+
+
+# --- formula parity ----------------------------------------------------------
+
+
+class TestScoringFormula:
+    def test_violated_slo_quadratic_penalty(self):
+        # SLO 10, predicted 8, no interference: slack 2, rel 0.2 →
+        # 1/(1+(1.2)^2) = 1/2.44
+        term, violated = slo_slack_terms(10.0, 8.0, 0.0)
+        assert violated
+        assert term == pytest.approx(1 / 2.44)
+
+    def test_satisfied_slo_linear(self):
+        # SLO 10, predicted 15: slack -5, rel 0.5 → 1/1.5
+        term, violated = slo_slack_terms(10.0, 15.0, 0.0)
+        assert not violated
+        assert term == pytest.approx(1 / 1.5)
+
+    def test_interference_flips_verdict(self):
+        _, ok_before = slo_slack_terms(10.0, 15.0, 0.0)
+        _, ok_after = slo_slack_terms(10.0, 15.0, 6.0)
+        assert (ok_before, ok_after) == (False, True)
+
+    def test_combine_blends_by_violation_fraction(self):
+        # 1 positive (sum .5), 1 negative (sum .25): k=0.5 →
+        # 100*(0.5*0.5 + 0.5*0.25) = 37.5
+        assert combine_terms(0.5, 1, 0.25, 1) == pytest.approx(37.5)
+        assert combine_terms(0.5, 1, 0.0, 0) == pytest.approx(50.0)
+        assert combine_terms(0.0, 0, 0.25, 1) == pytest.approx(25.0)
+        assert combine_terms(0.0, 0, 0.0, 0) == 0.0
+
+    def test_match_interference_normalizes_dashes(self):
+        row = {"bert_base": 3.0, "resnet": 1.0}
+        assert match_interference(row, "bert-base-serving-0") == 3.0
+        assert match_interference(row, "unrelated") == 0.0
+
+    def test_pod_slo_tolerant_parse(self):
+        assert pod_slo(mk_pod("a", slo=12.5)) == 12.5
+        assert pod_slo(mk_pod("a", slo="garbage")) == 0.0
+        assert pod_slo(mk_pod("a")) == 0.0
+
+
+# --- filter ------------------------------------------------------------------
+
+
+class TestTPUFilter:
+    def _plugin(self, server=None, registry=None):
+        sched = make_scheduler(server or APIServer(), registry=registry)
+        return sched, sched.profile.filter[0]
+
+    def test_insufficient_chips(self):
+        server = APIServer()
+        sched, plugin = self._plugin(server)
+        node = mk_node("n1", chips=4)
+        sched.cache.add_node(node)
+        info = sched.cache.snapshot()["n1"]
+        state = CycleState()
+        pod = mk_pod("p", chips=8)
+        assert plugin.pre_filter(state, pod).ok
+        st = plugin.filter(state, pod, info)
+        assert not st.ok and "insufficient" in st.message
+
+    def test_missing_labels_rejected_for_tpu_pod(self):
+        server = APIServer()
+        sched, plugin = self._plugin(server)
+        bare = Node(metadata=ObjectMeta(name="cpu1"),
+                    status=NodeStatus(allocatable={TPU_RESOURCE: 8}))
+        sched.cache.add_node(bare)
+        info = sched.cache.snapshot()["cpu1"]
+        st = plugin.filter(CycleState(), mk_pod("p", chips=1), info)
+        assert not st.ok and "labels" in st.message
+
+    def test_cpu_pod_lands_anywhere_ready(self):
+        server = APIServer()
+        sched, plugin = self._plugin(server)
+        bare = Node(metadata=ObjectMeta(name="cpu1"), status=NodeStatus())
+        sched.cache.add_node(bare)
+        info = sched.cache.snapshot()["cpu1"]
+        assert plugin.filter(CycleState(), mk_pod("busybox", chips=0), info).ok
+
+    def test_node_selector_respected(self):
+        server = APIServer()
+        sched, plugin = self._plugin(server)
+        sched.cache.add_node(mk_node("n1"))
+        info = sched.cache.snapshot()["n1"]
+        pod = mk_pod("p", chips=1)
+        pod.spec.node_selector = {"zone": "us-central2-b"}
+        assert not plugin.filter(CycleState(), pod, info).ok
+
+
+# --- score -------------------------------------------------------------------
+
+
+class TestTPUScore:
+    def test_utilization_fallback_prefers_idle_node(self):
+        """No SLO/recommender → 100*(1-duty) from agent-published inventory
+        (parity gpu_plugins.go:508-527, minus its return-0 bug)."""
+        server = APIServer()
+        reg = FakeRegistry()
+        reg.publish("busy", utilization=0.9)
+        reg.publish("idle", utilization=0.1)
+        sched = make_scheduler(server, registry=reg)
+        for n in ("busy", "idle"):
+            sched.cache.add_node(mk_node(n))
+        plugin = sched.profile.score[0]
+        state = CycleState()
+        pod = mk_pod("p", chips=1)
+        plugin.pre_filter(state, pod)
+        for name in ("busy", "idle"):
+            info = sched.cache.snapshot()[name]
+            assert plugin.filter(state, pod, info).ok
+        s_busy, _ = plugin.score(state, pod, "busy")
+        s_idle, _ = plugin.score(state, pod, "idle")
+        assert s_idle == pytest.approx(90.0)
+        assert s_busy == pytest.approx(10.0)
+
+    def test_prom_fallback_uses_percent_scale(self):
+        """node_duty_cycle returns 0..100 (metrics/client.py contract); the
+        fallback score must be 100-duty_pct, not a clamped fraction."""
+
+        class FakeProm:
+            def node_duty_cycle(self, node_name):
+                return {"busy": 87.5, "idle": 5.0}[node_name]
+
+        sched = make_scheduler(APIServer())
+        plugin = sched.profile.score[0]
+        plugin.prom = FakeProm()
+        for n in ("busy", "idle"):
+            sched.cache.add_node(mk_node(n))
+        state = CycleState()
+        pod = mk_pod("p", chips=1)
+        plugin.pre_filter(state, pod)
+        for n in ("busy", "idle"):
+            plugin.filter(state, pod, sched.cache.snapshot()[n])
+        assert plugin.score(state, pod, "busy")[0] == pytest.approx(12.5)
+        assert plugin.score(state, pod, "idle")[0] == pytest.approx(95.0)
+
+    def test_normalize_min_max(self):
+        sched = make_scheduler(APIServer())
+        plugin = sched.profile.score[0]
+        scores = {"a": 10.0, "b": 30.0, "c": 20.0}
+        plugin.normalize_scores(CycleState(), mk_pod("p"), scores)
+        assert scores == {"a": 0.0, "b": 100.0, "c": 50.0}
+        same = {"a": 42.0, "b": 42.0}
+        plugin.normalize_scores(CycleState(), mk_pod("p"), same)
+        assert same == {"a": 100.0, "b": 100.0}
+
+    def test_slo_scoring_avoids_contended_node(self):
+        """SLO-slack path: a node whose resident pod's SLO would be violated
+        by co-location scores below an empty one."""
+        server = APIServer()
+        reg = FakeRegistry()
+        reg.publish("loaded", utilization=0.0)
+        reg.publish("empty", utilization=0.0)
+        conf = {"bert": {"1P_V5E": 20.0}, "newpod": {"1P_V5E": 20.0}}
+        intf = {"bert": {"newpod": 15.0}, "newpod": {"bert": 15.0}}
+        rec = FakeRecommender(conf=conf, intf=intf)
+        sched = make_scheduler(server, registry=reg, recommender=rec)
+        for n in ("loaded", "empty"):
+            sched.cache.add_node(mk_node(n))
+        # Resident pod with SLO 18 on "loaded" (bound, known via cache).
+        resident = mk_pod("bert-0", chips=8, slo=18.0)
+        resident.spec.node_name = "loaded"
+        sched.cache.add_pod(resident)
+
+        plugin = sched.profile.score[0]
+        state = CycleState()
+        pod = mk_pod("newpod-0", chips=8, slo=18.0)
+        plugin.pre_filter(state, pod)
+        infos = sched.cache.snapshot()
+        assert plugin.filter(state, pod, infos["empty"]).ok
+        # "loaded" has 0 free chips for an 8-chip pod → filtered out; score
+        # the empty node and check the decision was stashed, not written.
+        s_empty, st = plugin.score(state, pod, "empty")
+        assert st.ok
+        # empty node: only the incoming pod contributes; conf 20 vs SLO 18,
+        # no co-located interference → satisfied: 1/(1+2/18) → *100
+        assert s_empty == pytest.approx(100 / (1 + 2.0 / 18.0))
+        assert state.read("tpu.decision/empty") is not None
+
+    def test_rightsizing_picks_cheapest_satisfying_config(self):
+        """V100-MPS right-sizing parity (gpu_plugins.go:638-666): smallest
+        predicted QPS that still clears the SLO wins."""
+        reg = FakeRegistry()
+        reg.publish("n1", utilization=0.0)
+        conf = {
+            "2x4": {"1P_V5E": 100.0},
+            "2x2": {"2P_V5E": 60.0},
+            "1x2": {"4P_V5E": 30.0},
+            "1x1": {"8P_V5E": 12.0},
+            "newpod": {"1P_V5E": 100.0},
+        }
+        rec = FakeRecommender(conf=conf, intf={})
+        sched = make_scheduler(APIServer(), registry=reg, recommender=rec)
+        sched.cache.add_node(mk_node("n1"))
+        plugin = sched.profile.score[0]
+        state = CycleState()
+        pod = mk_pod("newpod-0", chips=1, slo=25.0)
+        plugin.pre_filter(state, pod)
+        plugin.filter(state, pod, sched.cache.snapshot()["n1"])
+        plugin.score(state, pod, "n1")
+        decision = state.read("tpu.decision/n1")
+        # 30 QPS (1x2, 4-way) is the cheapest config above SLO 25.
+        assert decision.rightsized_config == "1x2"
+
+    def test_partition_carving_from_annotation(self):
+        """ANN_SLICE_CONFIG partitions the board — MIG-instance analogue."""
+        reg = FakeRegistry()
+        reg.publish("n1", utilization=0.0)
+        sched = make_scheduler(APIServer(), registry=reg)
+        sched.cache.add_node(
+            mk_node("n1", annotations={ANN_SLICE_CONFIG: "2x2"})
+        )
+        plugin = sched.profile.score[0]
+        state = CycleState()
+        pod = mk_pod("p", chips=4)
+        plugin.pre_filter(state, pod)
+        plugin.filter(state, pod, sched.cache.snapshot()["n1"])
+        plugin.score(state, pod, "n1")
+        decision = state.read("tpu.decision/n1")
+        assert decision.partition is not None
+        assert decision.partition.topology == "2x2"
+        assert decision.partition.chip_ids in ([0, 1, 2, 3], [4, 5, 6, 7])
+        # Shared host → HBM/duty caps (MPS-limit analogue).
+        assert decision.hbm_limit_bytes > 0
+        assert decision.duty_pct == 50
+
+
+# --- end-to-end: assignment + side-effect-free score -------------------------
+
+
+class TestTPUEndToEnd:
+    def test_postbind_injects_assignment_and_losers_untouched(self):
+        server = APIServer()
+        server.create(ConfigMap(metadata=ObjectMeta(name="cm-p"), data={}))
+        reg = FakeRegistry()
+        reg.publish("winner", utilization=0.0)
+        reg.publish("loser", utilization=0.8)
+        sched = make_scheduler(server, registry=reg)
+        server.create(mk_node("winner"))
+        server.create(mk_node("loser"))
+        pod = mk_pod("p-0", chips=8, cm="cm-p")
+        server.create(pod)
+        sched.start()
+        try:
+            assert wait_until(
+                lambda: server.get("Pod", "p-0", "default").spec.node_name
+            )
+            bound = server.get("Pod", "p-0", "default")
+            assert bound.spec.node_name == "winner"
+            cm = server.get("ConfigMap", "cm-p", "default")
+            # Device assignment injected (CUDA_VISIBLE_DEVICES analogue).
+            assert cm.data[ENV_VISIBLE_CHIPS] == "0,1,2,3,4,5,6,7"
+            assert cm.data[ENV_WORKER_ID] == "0"
+            # {nodeName: partition} parity key for the WINNER only — the
+            # loser key proves Score stayed side-effect-free.
+            assert "winner" in cm.data
+            assert "loser" not in cm.data
+        finally:
+            sched.stop()
+
+    def test_unpublished_node_still_schedulable(self):
+        """Registry reachable but node never published by an agent — the
+        conservative fallback still places the pod."""
+        server = APIServer()
+        server.create(ConfigMap(metadata=ObjectMeta(name="cm-q"), data={}))
+        sched = make_scheduler(server, registry=FakeRegistry())
+        server.create(mk_node("n1"))
+        server.create(mk_pod("q-0", chips=1, cm="cm-q"))
+        sched.start()
+        try:
+            assert wait_until(
+                lambda: server.get("Pod", "q-0", "default").spec.node_name
+            )
+        finally:
+            sched.stop()
+
+
+# --- gang --------------------------------------------------------------------
+
+
+def v5p_slice(pool, n_hosts=4, topo="2x2x4"):
+    """Nodes of one multi-host v5p slice: 4 chips/host, shared slice-group."""
+    return [
+        mk_node(
+            f"{pool}-w{i}",
+            chips=4,
+            gen="tpu-v5p-slice",
+            topo=topo,
+            labels={LABEL_SLICE_GROUP: pool, LABEL_WORKER_INDEX: str(i)},
+        )
+        for i in range(n_hosts)
+    ]
+
+
+class TestGang:
+    def _gang_setup(self, server, n_pods, min_member, timeout=5.0):
+        server.create(
+            PodGroup(
+                metadata=ObjectMeta(name="llama"),
+                min_member=min_member,
+                topology="2x2x4",
+                schedule_timeout_s=timeout,
+            )
+        )
+        pods = []
+        for i in range(n_pods):
+            server.create(ConfigMap(metadata=ObjectMeta(name=f"cm-g{i}"), data={}))
+            pod = mk_pod(f"llama-{i}", chips=4, cm=f"cm-g{i}", group="llama")
+            server.create(pod)
+            pods.append(pod)
+        return pods
+
+    def test_full_gang_lands_atomically(self):
+        """BASELINE config 4: a 4-pod v5p-16 gang lands on the 4 hosts of one
+        slice, each host exactly one member, with worker env injected."""
+        server = APIServer()
+        for n in v5p_slice("pool-a"):
+            server.create(n)
+        sched = make_scheduler(server, registry=FakeRegistry(), with_gang=True)
+        self._gang_setup(server, n_pods=4, min_member=4)
+        sched.start()
+        try:
+            assert wait_until(
+                lambda: all(
+                    server.get("Pod", f"llama-{i}", "default").spec.node_name
+                    for i in range(4)
+                ),
+                timeout=10,
+            )
+            nodes = {
+                server.get("Pod", f"llama-{i}", "default").spec.node_name
+                for i in range(4)
+            }
+            assert nodes == {f"pool-a-w{i}" for i in range(4)}  # one per host
+            # Worker env: distinct ids 0..3, identical hostnames list.
+            ids, hostlists = set(), set()
+            for i in range(4):
+                cm = server.get("ConfigMap", f"cm-g{i}", "default")
+                ids.add(cm.data[ENV_WORKER_ID])
+                hostlists.add(cm.data[ENV_WORKER_HOSTNAMES])
+            assert ids == {"0", "1", "2", "3"}
+            assert len(hostlists) == 1
+            assert hostlists.pop().split(",") == [f"pool-a-w{i}" for i in range(4)]
+        finally:
+            sched.stop()
+
+    def test_capacity_short_gang_admits_zero(self):
+        """3 hosts for a min_member=4 gang: nothing may bind; after the
+        permit timeout all chips are credited back."""
+        server = APIServer()
+        for n in v5p_slice("pool-a", n_hosts=3):
+            server.create(n)
+        cfg = SchedulerConfig(
+            backoff_initial_s=10, backoff_max_s=10, permit_timeout_s=0.4
+        )
+        sched = make_scheduler(
+            server, registry=FakeRegistry(), with_gang=True, config=cfg
+        )
+        self._gang_setup(server, n_pods=4, min_member=4, timeout=0.4)
+        sched.start()
+        try:
+            # Let the gang attempt, park, and time out.
+            assert wait_until(
+                lambda: not sched.handle._waiting
+                and all(
+                    not server.get("Pod", f"llama-{i}", "default").spec.node_name
+                    for i in range(4)
+                )
+                and sum(i.requested_tpu for i in sched.cache.snapshot().values()) == 0,
+                timeout=10,
+            ), "gang must fully roll back: no binds, no leaked chips"
+        finally:
+            sched.stop()
+
+    def test_gang_members_share_one_slice(self):
+        """Two 2-host pools; a min_member=2 gang must not straddle pools."""
+        server = APIServer()
+        for n in v5p_slice("pool-a", n_hosts=2, topo="2x2x2") + v5p_slice(
+            "pool-b", n_hosts=2, topo="2x2x2"
+        ):
+            server.create(n)
+        sched = make_scheduler(server, registry=FakeRegistry(), with_gang=True)
+        server.create(
+            PodGroup(
+                metadata=ObjectMeta(name="llama"),
+                min_member=2,
+                topology="2x2x2",
+                schedule_timeout_s=5.0,
+            )
+        )
+        for i in range(2):
+            server.create(ConfigMap(metadata=ObjectMeta(name=f"cm-g{i}"), data={}))
+            server.create(mk_pod(f"llama-{i}", chips=4, cm=f"cm-g{i}", group="llama"))
+        sched.start()
+        try:
+            assert wait_until(
+                lambda: all(
+                    server.get("Pod", f"llama-{i}", "default").spec.node_name
+                    for i in range(2)
+                ),
+                timeout=10,
+            )
+            pools = {
+                server.get("Pod", f"llama-{i}", "default").spec.node_name.rsplit("-w", 1)[0]
+                for i in range(2)
+            }
+            assert len(pools) == 1, f"gang straddled slices: {pools}"
+        finally:
+            sched.stop()
+
+    def test_missing_group_is_unschedulable(self):
+        server = APIServer()
+        for n in v5p_slice("pool-a"):
+            server.create(n)
+        sched = make_scheduler(server, registry=FakeRegistry(), with_gang=True)
+        server.create(mk_pod("orphan-0", chips=4, group="nosuch"))
+        sched.start()
+        try:
+            assert wait_until(
+                lambda: "not found"
+                in sched.failure_reasons.get("default/orphan-0", "")
+            )
+        finally:
+            sched.stop()
