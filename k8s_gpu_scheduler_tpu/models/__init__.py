@@ -1,0 +1,11 @@
+"""Workload layer — the JAX models this scheduler places and benches.
+
+The reference schedules opaque inference containers (onnx_* workloads in its
+recommender matrices) and ships no models. Our BASELINE configs name real
+workloads (resnet/bert/llama), so the framework carries a small TPU-native
+model zoo: everything jit-compiled, bf16, static-shaped, sharded via
+parallel/ — the flagship (llama) is what __graft_entry__/bench.py drive.
+"""
+from .llama import LlamaConfig, init_params, forward, loss_fn, make_train_step
+
+__all__ = ["LlamaConfig", "init_params", "forward", "loss_fn", "make_train_step"]

@@ -1,0 +1,222 @@
+"""Llama-family decoder — the flagship workload (BASELINE config 4).
+
+Design is TPU-first, not a torch port:
+- params are a plain pytree with layers STACKED on a leading axis and the
+  forward pass runs ``lax.scan`` over them — one trace/compile per block
+  stack instead of per layer, the XLA-friendly shape;
+- ``jax.checkpoint`` on the scanned block trades FLOPs for HBM (remat);
+- bf16 activations/weights, f32 norm/softmax stats (MXU-shaped matmuls);
+- parallelism is declarative: logical axes on every param
+  (``param_axes``) + the rules table in parallel/sharding.py produce
+  PartitionSpecs; ``make_train_step`` jits with those shardings and lets
+  GSPMD insert the tp all-reduces. Sequence parallelism (ring/Ulysses) is
+  a ``shard_map`` island around the attention call only.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import dense_attention, ring_attention, ulysses_attention
+from ..ops.layers import apply_rope, rms_norm, rope_freqs, swiglu
+from ..parallel.sharding import logical_axis_rules, spec_for
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "dense"  # dense | ring | ulysses
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            d_ff=14336, max_seq=8192,
+        )
+
+    @staticmethod
+    def tiny(attn_impl: str = "dense") -> "LlamaConfig":
+        """Test/dryrun scale: full architecture, toy widths."""
+        return LlamaConfig(
+            vocab=256, d_model=64, n_layers=2, n_heads=8, n_kv_heads=8,
+            d_ff=128, max_seq=128, attn_impl=attn_impl, remat=False,
+        )
+
+    def flops_per_token(self) -> float:
+        """Approximate train-step FLOPs/token (fwd+bwd ≈ 6×params matmul
+        FLOPs + attention) — the MFU numerator bench.py uses."""
+        p_block = (
+            self.d_model * self.n_heads * self.head_dim  # wq
+            + 2 * self.d_model * self.n_kv_heads * self.head_dim  # wk, wv
+            + self.n_heads * self.head_dim * self.d_model  # wo
+            + 3 * self.d_model * self.d_ff  # gate/up/down
+        )
+        p_matmul = self.n_layers * p_block + 2 * self.vocab * self.d_model
+        return 6.0 * p_matmul
+
+
+def param_axes(cfg: LlamaConfig) -> Dict:
+    """Logical sharding axes for every param leaf (leading 'layers' axis on
+    the stacked blocks is never sharded)."""
+    L = ("layers",)
+    return {
+        "embed": ("vocab", "embed"),
+        "blocks": {
+            "attn_norm": L + ("norm",),
+            "wq": L + ("embed", "heads"),
+            "wk": L + ("embed", "kv_heads"),
+            "wv": L + ("embed", "kv_heads"),
+            "wo": L + ("heads", "embed"),
+            "mlp_norm": L + ("norm",),
+            "w_gate": L + ("embed", "mlp"),
+            "w_up": L + ("embed", "mlp"),
+            "w_down": L + ("mlp", "embed"),
+        },
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def param_specs(cfg: LlamaConfig, rules: Optional[Dict] = None) -> Dict:
+    rules = rules or logical_axis_rules({"layers": None})
+    return jax.tree.map(
+        lambda axes: spec_for(axes, rules),
+        param_axes(cfg),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict:
+    ks = jax.random.split(key, 8)
+    D, H, Hkv, hd, F, L = (
+        cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff,
+        cfg.n_layers,
+    )
+
+    def norm(k, *shape):
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(cfg.dtype)
+
+    return {
+        "embed": norm(ks[0], cfg.vocab, D),
+        "blocks": {
+            "attn_norm": jnp.ones((L, D), cfg.dtype),
+            "wq": norm(ks[1], L, D, H * hd),
+            "wk": norm(ks[2], L, D, Hkv * hd),
+            "wv": norm(ks[3], L, D, Hkv * hd),
+            "wo": norm(ks[4], L, H * hd, D),
+            "mlp_norm": jnp.ones((L, D), cfg.dtype),
+            "w_gate": norm(ks[5], L, D, F),
+            "w_up": norm(ks[6], L, D, F),
+            "w_down": norm(ks[7], L, F, D),
+        },
+        "final_norm": jnp.ones((D,), cfg.dtype),
+        "lm_head": norm(ks[0], D, cfg.vocab),
+    }
+
+
+def _attention(cfg: LlamaConfig, mesh: Optional[Mesh], q, k, v):
+    """Dispatch dense vs sequence-parallel attention. q/k/v are GLOBAL
+    [B, T, H(kv), hd]; the shard_map island re-chunks T over 'sp' and heads
+    over 'tp' and runs the ring/all_to_all collectives inside."""
+    if cfg.attn_impl == "dense" or mesh is None or "sp" not in mesh.axis_names:
+        return dense_attention(q, k, v, causal=True)
+    if mesh.shape["sp"] == 1:
+        return dense_attention(q, k, v, causal=True)
+    impl = ring_attention if cfg.attn_impl == "ring" else ulysses_attention
+    spec = P(("dp", "fsdp"), "sp", "tp", None)
+    fn = jax.shard_map(
+        partial(impl, axis_name="sp", causal=True),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def forward(
+    params: Dict, tokens: jax.Array, cfg: LlamaConfig, mesh: Optional[Mesh] = None
+) -> jax.Array:
+    """tokens [B, T] int32 → logits [B, T, vocab]."""
+    B, T = tokens.shape
+    angles = rope_freqs(cfg.head_dim, T, cfg.rope_theta)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = _constrain(x, mesh, P(("dp", "fsdp"), "sp", None))
+
+    def block(x, blk):
+        h = rms_norm(x, blk["attn_norm"])
+        q = (h @ blk["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
+        k = (h @ blk["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ blk["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q, k = apply_rope(q, angles), apply_rope(k, angles)
+        attn = _attention(cfg, mesh, q, k, v)
+        x = x + attn.reshape(B, T, cfg.n_heads * cfg.head_dim) @ blk["wo"]
+        h = rms_norm(x, blk["mlp_norm"])
+        x = x + swiglu(h, blk["w_gate"], blk["w_up"], blk["w_down"])
+        x = _constrain(x, mesh, P(("dp", "fsdp"), "sp", None))
+        return x, None
+
+    block_fn = jax.checkpoint(block) if cfg.remat else block
+    x, _ = jax.lax.scan(block_fn, x, params["blocks"])
+    x = rms_norm(x, params["final_norm"])
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def _constrain(x, mesh, spec):
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def loss_fn(
+    params: Dict, batch: Dict, cfg: LlamaConfig, mesh: Optional[Mesh] = None
+) -> jax.Array:
+    """Causal-LM cross entropy; batch = {tokens [B,T], targets [B,T]}."""
+    logits = forward(params, batch["tokens"], cfg, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)
+    return nll.mean()
+
+
+def make_train_step(cfg: LlamaConfig, mesh: Optional[Mesh], optimizer):
+    """Build the jitted SPMD train step: value_and_grad + optimizer update,
+    params/opt-state sharded per param_specs, batch over (dp, fsdp)."""
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u.astype(p.dtype), params, updates)
+        return params, opt_state, loss
+
+    if mesh is None:
+        return jax.jit(step)
+
+    pspecs = param_specs(cfg)
+    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,
+                          is_leaf=lambda x: isinstance(x, P))
+    batch_shard = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    # Optimizer state mirrors param sharding leaf-for-leaf (adam's mu/nu have
+    # param shapes; scalars replicate).
+    return jax.jit(
+        step,
+        in_shardings=(pshard, None, {"tokens": batch_shard, "targets": batch_shard}),
+        donate_argnums=(0, 1),
+    )

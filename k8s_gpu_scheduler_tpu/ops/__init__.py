@@ -1,0 +1,20 @@
+"""Core ops — TPU-friendly building blocks for the workload layer.
+
+Everything here is jit-traceable with static shapes, keeps the FLOPs in
+large bf16 matmuls (MXU-shaped), and uses `lax` control flow only. The
+sequence-parallel attention variants (ring via ppermute, Ulysses via
+all_to_all) are the long-context capability SURVEY.md §5 requires the
+rebuild to treat as first-class.
+"""
+from .layers import apply_rope, rms_norm, rope_freqs, swiglu
+from .attention import dense_attention, ring_attention, ulysses_attention
+
+__all__ = [
+    "rms_norm",
+    "rope_freqs",
+    "apply_rope",
+    "swiglu",
+    "dense_attention",
+    "ring_attention",
+    "ulysses_attention",
+]

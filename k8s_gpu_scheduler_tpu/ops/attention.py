@@ -1,0 +1,135 @@
+"""Attention: dense, ring (sequence-parallel), and Ulysses (head-parallel).
+
+Long-context is first-class in this framework (SURVEY.md §5: the reference
+has no sequence dimension at all; our scheduler gang-places jobs that DO).
+Two sequence-parallel schemes, both pure XLA collectives over ICI:
+
+- **ring_attention**: K/V blocks rotate around the ``sp`` axis via
+  ``ppermute`` while each device keeps flash-style running softmax stats
+  (m, l) — O(T/n) memory per device, communication overlapped by XLA with
+  the per-block matmuls. The blockwise-softmax recurrence follows the
+  public blockwise/ring attention formulation (Liu et al.; PAPERS.md).
+- **ulysses_attention**: two ``all_to_all``s re-shard [B, T/n, H, d] →
+  [B, T, H/n, d] so each device runs DENSE attention on full sequence for
+  a head subset — cheaper at moderate T, requires H % n == 0.
+
+Both are called inside ``shard_map`` (the model wraps them); dense_attention
+is the single-device reference the tests check them against.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() exact zero
+                  # without inf-inf → NaN when a whole row is masked
+
+
+def _repeat_kv(k: jax.Array, n_heads: int) -> jax.Array:
+    """GQA: tile kv heads up to n_heads. k: [B, T, Hkv, d]."""
+    h_kv = k.shape[2]
+    if h_kv == n_heads:
+        return k
+    return jnp.repeat(k, n_heads // h_kv, axis=2)
+
+
+def dense_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True
+) -> jax.Array:
+    """Reference attention. q: [B, Tq, H, d]; k/v: [B, Tk, Hkv, d]."""
+    *_, n_heads, head_dim = q.shape
+    k = _repeat_kv(k, n_heads)
+    v = _repeat_kv(v, n_heads)
+    scale = 1.0 / math.sqrt(head_dim)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Sequence-parallel attention inside shard_map over ``axis_name``.
+
+    Shapes are PER-DEVICE: q/k/v [B, T/n, H(kv), d]. After ``s`` rotations
+    device ``i`` holds the K/V block that started on device ``(i-s) mod n``,
+    so global causal masking only needs the block indices.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, t_local, n_heads, head_dim = q.shape
+    k = _repeat_kv(k, n_heads)
+    v = _repeat_kv(v, n_heads)
+    scale = 1.0 / math.sqrt(head_dim)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = my_idx * t_local + jnp.arange(t_local)  # global query positions
+
+    def step(s, carry):
+        o, m, l, k_blk, v_blk = carry
+        src = (my_idx - s) % n
+        scores = (
+            jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+        )
+        if causal:
+            k_pos = src * t_local + jnp.arange(t_local)
+            allowed = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(allowed, scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o_new, m_new, l_new, k_next, v_next
+
+    o0 = jnp.zeros((b, n_heads, t_local, head_dim), jnp.float32)
+    m0 = jnp.full((b, n_heads, t_local), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n_heads, t_local), jnp.float32)
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+    out = o / jnp.maximum(l, 1e-20)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style): re-shard
+    seq→heads, dense-attend full sequence locally, re-shard heads→seq.
+    Per-device in/out: [B, T/n, H, d]; requires H divisible by n (GQA kv
+    heads are replicated up to H first — the scatter must split heads)."""
+    n = jax.lax.psum(1, axis_name)
+    n_heads = q.shape[2]
+    if n_heads % n:
+        raise ValueError(f"ulysses needs heads ({n_heads}) divisible by sp ({n})")
+    k = _repeat_kv(k, n_heads)
+    v = _repeat_kv(v, n_heads)
+    a2a = partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
+        tiled=True,
+    )
+    q_full = a2a(q)  # [B, T, H/n, d]
+    k_full = a2a(k)
+    v_full = a2a(v)
+    out = dense_attention(q_full, k_full, v_full, causal=causal)
+    return jax.lax.all_to_all(
+        out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )

@@ -1,0 +1,42 @@
+"""Transformer primitives: RMSNorm, RoPE, SwiGLU.
+
+Numerics follow the common Llama-family conventions. Norms and softmax
+statistics compute in f32 regardless of activation dtype (bf16 on TPU) —
+the MXU takes bf16 inputs with f32 accumulation, so only the
+bandwidth-bound elementwise stats need explicit upcasting.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(dtype) * weight
+
+
+def rope_freqs(head_dim: int, max_len: int, theta: float = 10000.0) -> jax.Array:
+    """[max_len, head_dim//2] complex-free rotation angles."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    return jnp.outer(t, inv)  # [T, hd/2]
+
+
+def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
+    """Rotate pairs of channels. x: [..., T, H, hd]; angles: [T, hd/2]."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = jnp.cos(angles)[..., :, None, :]
+    sin = jnp.sin(angles)[..., :, None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array) -> jax.Array:
+    """SwiGLU MLP: down( silu(x@gate) * (x@up) ). Three matmuls — the
+    gate/up pair is column-parallel under tp, down row-parallel
+    (parallel/sharding.py conventions)."""
+    g = jax.nn.silu(x @ w_gate)
+    return (g * (x @ w_up)) @ w_down

@@ -1,0 +1,20 @@
+"""Parallelism layer — device meshes, sharding rules, and collectives.
+
+The reference has NO data-plane parallelism (SURVEY.md §2: "None of
+DP/TP/PP/SP/EP/CP/ring-attention/Ulysses exist"); its scheduler places
+single-GPU pods. Our framework schedules multi-host JAX jobs, so the
+workloads it places — and benches with — need a real parallel substrate:
+meshes with dp/fsdp/tp/sp axes, NamedSharding rules, and sequence-parallel
+attention built on XLA collectives over ICI (ppermute ring, all_to_all
+Ulysses) rather than NCCL/MPI.
+"""
+from .mesh import MeshSpec, make_mesh, named_sharding
+from .sharding import logical_axis_rules, shard_params_spec
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "named_sharding",
+    "logical_axis_rules",
+    "shard_params_spec",
+]

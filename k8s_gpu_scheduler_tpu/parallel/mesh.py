@@ -1,0 +1,62 @@
+"""Device-mesh construction for dp/fsdp/tp/sp layouts.
+
+TPU scaling rides `jax.sharding.Mesh` + NamedSharding: pick a mesh whose
+axes map onto the slice's ICI torus, annotate shardings, and let XLA insert
+the collectives. `make_mesh` uses `mesh_utils.create_device_mesh` so axis
+order follows the physical torus (innermost axis = fastest ICI ring) —
+model-parallel axes (tp, sp) should be innermost, data-parallel outermost,
+mirroring how a gang placed by our scheduler spans hosts (outer axes cross
+hosts over DCN/outer ICI, inner axes stay intra-host).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Named mesh shape, e.g. {'dp': 2, 'fsdp': 2, 'tp': 2}. Axis size 1 is
+    legal and keeps the axis name addressable (so one model definition runs
+    from 1 chip to a pod)."""
+
+    axes: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def names(self) -> tuple:
+        return tuple(self.axes.keys())
+
+    @property
+    def shape(self) -> tuple:
+        return tuple(self.axes.values())
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.axes else 1
+
+    @staticmethod
+    def for_devices(n: int, fsdp: int = 1, sp: int = 1, tp: int = 1) -> "MeshSpec":
+        """Default 4-axis layout for n devices: fill fsdp/sp/tp as asked,
+        rest is dp. All four axis names always exist (size 1 where unused) so
+        one set of PartitionSpecs works at any scale."""
+        denom = fsdp * sp * tp
+        if n % denom:
+            raise ValueError(f"{n} devices not divisible by fsdp*sp*tp={denom}")
+        return MeshSpec({"dp": n // denom, "fsdp": fsdp, "sp": sp, "tp": tp})
+
+
+def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    if spec.size > len(devices):
+        raise ValueError(f"mesh {spec.axes} needs {spec.size} devices, have {len(devices)}")
+    grid = mesh_utils.create_device_mesh(spec.shape, devices=devices[: spec.size])
+    return Mesh(grid, spec.names)
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

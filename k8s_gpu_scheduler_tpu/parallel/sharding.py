@@ -1,0 +1,62 @@
+"""Sharding rules: logical axis names → mesh axes → NamedShardings.
+
+Megatron-style tensor parallelism expressed the JAX way: every parameter
+declares logical axes ('embed', 'mlp', 'heads', 'vocab'...); one rules table
+maps logical axes to mesh axes; `shard_params_spec` walks a params pytree of
+`(path, shape)` and emits PartitionSpecs. XLA's GSPMD partitioner then
+inserts the all-reduces a hand-written NCCL backend would need explicit
+calls for.
+
+Conventions (standard 1D-tp transformer):
+- column-parallel inputs→hidden weights shard the OUTPUT axis on tp
+  (q/k/v/gate/up projections, logical axis 'heads'/'mlp');
+- row-parallel hidden→outputs shard the INPUT axis on tp (o/down
+  projections) — the following psum is XLA-inserted;
+- fsdp shards the remaining large axis ('embed') of every weight;
+- activations: batch on ('dp','fsdp'), sequence on 'sp' (ring attention),
+  heads on 'tp'.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+# logical axis -> mesh axis (None = replicate). The sp axis never shards
+# WEIGHTS — it only shards the sequence dimension of activations.
+DEFAULT_RULES: Dict[str, object] = {
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "mlp": "tp",
+    "vocab": "tp",
+    "head_dim": None,
+    "norm": None,
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+}
+
+
+def logical_axis_rules(overrides: Dict[str, object] = None) -> Dict[str, object]:
+    rules = dict(DEFAULT_RULES)
+    if overrides:
+        rules.update(overrides)
+    return rules
+
+
+def spec_for(logical_axes: Tuple[str, ...], rules: Dict[str, object]) -> P:
+    return P(*(rules.get(a) for a in logical_axes))
+
+
+def shard_params_spec(param_axes, rules: Dict[str, object] = None):
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    import jax
+
+    rules = rules or DEFAULT_RULES
+    return jax.tree.map(
+        lambda axes: spec_for(axes, rules),
+        param_axes,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x
+        ),
+    )

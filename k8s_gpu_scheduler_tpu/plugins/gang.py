@@ -147,7 +147,12 @@ class GangPlugin(
             topo = info.slice_topology()
             if topo is None:
                 return Status.unschedulable("node missing TPU topology labels")
-            want = SliceTopology.parse(topo.gen, group.topology)
+            try:
+                want = SliceTopology.parse(topo.gen, group.topology)
+            except ValueError as e:
+                # PodGroup.topology is user data — a malformed value must be
+                # a terminal verdict, not a retry-storm exception.
+                return Status.unschedulable(f"bad gang topology: {e}")
             if topo.dims != want.dims:
                 return Status.unschedulable(
                     f"slice shape {topo.dims} != gang topology {want.dims}"
@@ -193,8 +198,10 @@ class GangPlugin(
             # slice origin and leave contiguous room for the next gang.
             return float(MAX_NODE_SCORE - min(worker_index_of(info), MAX_NODE_SCORE)), Status.success()
         # Later members: minimize added ICI hops to the reserved peers.
+        # Distances are measured on the HOST grid (host_grid units), not chip
+        # dims — wraparound shortcuts exist at host granularity too.
         try:
-            coords = topo.gen and self._host_coords(topo)
+            coords, grid = self._host_coords(topo)
         except ValueError:
             return 0.0, Status.success()
         peers = self._peer_indices(assigned)
@@ -203,17 +210,17 @@ class GangPlugin(
             return 0.0, Status.success()
         wrap = topo.has_wraparound
         added = sum(
-            ici_hop_distance(coords[mine], coords[p], topo.dims, wrap=wrap)
+            ici_hop_distance(coords[mine], coords[p], grid, wrap=wrap)
             for p in peers
         )
-        worst = (sum(topo.dims)) * max(len(peers), 1)
+        worst = sum(grid) * max(len(peers), 1)
         return max(0.0, MAX_NODE_SCORE * (1.0 - added / max(worst, 1))), Status.success()
 
     @staticmethod
-    def _host_coords(topo: SliceTopology) -> List[Tuple[int, ...]]:
-        from ..api.topology import host_coordinates
+    def _host_coords(topo: SliceTopology):
+        from ..api.topology import host_coordinates, host_grid
 
-        return host_coordinates(topo.dims, topo.gen)
+        return host_coordinates(topo.dims, topo.gen), host_grid(topo.dims, topo.gen)
 
     def _peer_indices(self, assigned: Dict[str, str]) -> List[int]:
         out = []
@@ -253,6 +260,44 @@ class GangPlugin(
                 wp.reject(reason)
 
         self.handle.iterate_waiting_pods(maybe_reject)
+        # Post-quorum failure window: peers that were already ALLOWED and
+        # bound are no longer waiting, but a gang with a missing worker
+        # deadlocks jax.distributed init. Evict members that are bound yet
+        # still Pending (never started) so the owner recreates them and the
+        # gang reschedules as a unit; Running members mean the gang
+        # previously succeeded and must not be touched.
+        ns, name = group_key.split("/", 1)
+        try:
+            pods = self.handle.factory.informer("Pod").list()
+            group = self.handle.descriptor.server.get("PodGroup", name, ns)
+        except Exception:  # noqa: BLE001 — informer not started / group gone
+            return
+        bound = [
+            p for p in pods
+            if p.metadata.namespace == ns and p.pod_group() == name
+            and p.spec.node_name and p.status.phase not in ("Succeeded", "Failed")
+        ]
+        if len(bound) >= group.min_member:
+            # The gang is still viable (a straggler beyond min_member
+            # failed) — leave the quorum alone.
+            return
+        for p in pods:
+            if (
+                p.metadata.namespace == ns
+                and p.pod_group() == name
+                and p.spec.node_name
+                and p.status.phase == "Pending"
+            ):
+                log.warning(
+                    "gang %s collapsed (%s): evicting bound member %s",
+                    group_key, reason, p.metadata.key,
+                )
+                try:
+                    self.handle.descriptor.delete_pod(
+                        p.metadata.name, p.metadata.namespace
+                    )
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
 
     # -- Permit ------------------------------------------------------------
     def permit(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[Status, float]:

@@ -359,6 +359,19 @@ class TPUPlugin(
         incoming_intf = self.recommender.impute_interference(
             f"{pod.metadata.name}_{gen}"
         )
+        # Hoist per-resident-pod predictions out of the partition loop —
+        # conf_index and gen are loop-invariant, so with the real gRPC
+        # recommender this is 2 roundtrips per resident pod instead of
+        # 2 × partition_count (the reference pays the full quadratic cost,
+        # gpu_plugins.go:577-590).
+        pred_cache: Dict[str, Tuple[Optional[float], Dict[str, float]]] = {}
+        for names in placed.values():
+            for other_name in names:
+                if other_name not in pred_cache:
+                    pred_cache[other_name] = (
+                        self.recommender.impute_configurations(other_name).get(conf_index),
+                        self.recommender.impute_interference(f"{other_name}_{gen}"),
+                    )
         for part in partitions:
             if len(part.chip_ids) < chips_wanted:
                 continue
@@ -367,10 +380,9 @@ class TPUPlugin(
             for other_name, other_slo in co_located.items():
                 if other_slo <= 0:
                     continue
-                conf = self.recommender.impute_configurations(other_name).get(conf_index)
+                conf, intf_row = pred_cache[other_name]
                 if conf is None:
                     continue
-                intf_row = self.recommender.impute_interference(f"{other_name}_{gen}")
                 intf = sum(
                     match_interference(intf_row, third)
                     for third in co_located
@@ -437,10 +449,15 @@ class TPUPlugin(
     ) -> List[Partition]:
         """Carve the host board into assignable partitions according to the
         node's current slice config annotation (the nvidia.com/mig.config
-        analogue) — default one whole-board partition."""
+        analogue) — default one whole-board partition. Board size comes from
+        host_board (a multi-host v5e host owns a 2x2 4-chip board, NOT the
+        full 2x4 — topology.py:100-118), so partition chip ids always exist
+        on this host."""
         from ..api.objects import ANN_SLICE_CONFIG
+        from ..api.topology import format_topology, host_board
 
-        total = topo.gen.chips_per_host if topo.is_multi_host else topo.chips
+        board = host_board(topo.dims, topo.gen)
+        total = chip_count(board)
         cfg = info.node.metadata.annotations.get(ANN_SLICE_CONFIG, "")
         if cfg:
             try:
@@ -448,7 +465,7 @@ class TPUPlugin(
             except ValueError:
                 per = total
         else:
-            cfg = info.node.tpu_topology() or ""
+            cfg = format_topology(board)
             per = total
         per = max(1, min(per, total))
         count = total // per

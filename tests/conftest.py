@@ -11,3 +11,9 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon TPU plugin (tunnel to the single real chip) registers itself even
+# when JAX_PLATFORMS=cpu is exported; the config flag wins, so force it too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -355,6 +355,23 @@ class TestTPUScore:
         # 30 QPS (1x2, 4-way) is the cheapest config above SLO 25.
         assert decision.rightsized_config == "1x2"
 
+    def test_multihost_partitions_limited_to_host_board(self):
+        """A multi-host v5e 4x4 host owns a 2x2 4-chip board — assignments
+        must never name chips 4..7 that don't exist on the host."""
+        reg = FakeRegistry()
+        reg.publish("w0", utilization=0.0)
+        sched = make_scheduler(APIServer(), registry=reg)
+        sched.cache.add_node(mk_node("w0", chips=4, topo="4x4"))
+        plugin = sched.profile.score[0]
+        state = CycleState()
+        pod = mk_pod("p", chips=4)
+        plugin.pre_filter(state, pod)
+        assert plugin.filter(state, pod, sched.cache.snapshot()["w0"]).ok
+        plugin.score(state, pod, "w0")
+        decision = state.read("tpu.decision/w0")
+        assert decision.partition.chip_ids == [0, 1, 2, 3]
+        assert decision.partition.topology == "2x2"
+
     def test_partition_carving_from_annotation(self):
         """ANN_SLICE_CONFIG partitions the board — MIG-instance analogue."""
         reg = FakeRegistry()
