@@ -1,0 +1,14 @@
+"""Node agent — per-host inventory/utilization publisher (C14-C16 parity).
+
+The reference's DaemonSet loop (pkg/profiler/profile_gpu.sh:3-13) scrapes
+``nvidia-smi -L`` every 2 s and pipes changed UUID sets into a Go publisher
+that writes Redis (cmd/client/client.go:24-79). Ours scrapes the native
+``tpuprobe`` binary (native/tpuprobe — the C++ obligation the reference left
+dead) and publishes a TYPED ``NodeInventory`` (chips, topology labels,
+utilization) to the registry, still on change-detection with a periodic
+heartbeat refresh.
+"""
+from .scrape import Scraper, probe_binary_path
+from .publisher import Publisher
+
+__all__ = ["Scraper", "Publisher", "probe_binary_path"]

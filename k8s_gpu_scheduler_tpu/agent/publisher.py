@@ -1,0 +1,122 @@
+"""Publish loop — change-detected inventory writes to the registry.
+
+Parity with profile_gpu.sh:3-13 + cmd/client/client.go:24-79: scrape every
+``interval_s``; publish only when the inventory CHANGED (the shell loop
+diffs UUID sets) or the heartbeat is older than ``heartbeat_s`` (ours adds a
+liveness key so the scheduler can age out dead agents — the reference's
+registry entries live forever). Node identity arrives via the same downward
+API env the reference uses (NODE_NAME, client-daemonset.yaml:26-40), node
+labels via explicit args (in-cluster they'd come from the Node object)."""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from ..registry.inventory import (
+    HEARTBEAT_SUFFIX,
+    NodeInventory,
+    node_key,
+    publish_inventory,
+)
+from .scrape import Scraper
+
+log = logging.getLogger(__name__)
+
+
+class Publisher:
+    def __init__(
+        self,
+        registry,
+        scraper: Optional[Scraper] = None,
+        node_name: Optional[str] = None,
+        accelerator: str = "",
+        topology: str = "",
+        worker_id: int = 0,
+        interval_s: float = 2.0,
+        heartbeat_s: float = 30.0,
+    ):
+        self.registry = registry
+        self.scraper = scraper or Scraper()
+        self.node_name = node_name or os.environ.get("NODE_NAME", "")
+        if not self.node_name:
+            raise ValueError("node name required (arg or NODE_NAME env)")
+        self.accelerator = accelerator or os.environ.get("TPU_ACCELERATOR_TYPE", "")
+        self.topology = topology or os.environ.get("TPU_TOPOLOGY", "")
+        self.worker_id = worker_id
+        self.interval_s = interval_s
+        self.heartbeat_s = heartbeat_s
+        self._last_json: Optional[str] = None
+        self._last_publish = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def build_inventory(self) -> NodeInventory:
+        chips = self.scraper.scrape()
+        util = (
+            sum(c.duty_cycle for c in chips) / len(chips) if chips else 0.0
+        )
+        return NodeInventory(
+            node_name=self.node_name,
+            accelerator=self.accelerator,
+            topology=self.topology,
+            chips=chips,
+            worker_id=self.worker_id,
+            utilization=util,
+            published_at=time.time(),
+        )
+
+    def publish_once(self, force: bool = False) -> bool:
+        """Scrape and publish if changed/stale. Returns True if written."""
+        inv = self.build_inventory()
+        # Change detection must ignore the timestamp (else every tick
+        # "changes") — compare the payload with published_at zeroed.
+        probe = NodeInventory(**{**inv.__dict__, "published_at": 0.0}).to_json()
+        stale = time.time() - self._last_publish >= self.heartbeat_s
+        if not force and not stale and probe == self._last_json:
+            return False
+        publish_inventory(self.registry, inv)
+        self.registry.set(
+            node_key(self.node_name) + HEARTBEAT_SUFFIX, str(inv.published_at)
+        )
+        self._last_json = probe
+        self._last_publish = time.time()
+        return True
+
+    # -- loop --------------------------------------------------------------
+    def start(self) -> "Publisher":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"agent-{self.node_name}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.publish_once()
+            except Exception:  # noqa: BLE001 — scrape/registry hiccups retry
+                log.exception("agent publish failed for %s", self.node_name)
+            self._stop.wait(self.interval_s)
+
+
+def main() -> None:  # pragma: no cover — exercised via CLI
+    from ..config import SchedulerConfig
+    from ..registry.client import Client
+
+    logging.basicConfig(level=logging.INFO)
+    cfg = SchedulerConfig.from_env()
+    registry = Client(cfg.registry.host, cfg.registry.port,
+                      password=cfg.registry.password)
+    Publisher(registry)._run()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

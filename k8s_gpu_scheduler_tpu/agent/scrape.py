@@ -1,0 +1,66 @@
+"""Inventory scraping — exec the native prober, parse its JSON.
+
+Parity with the scrape half of the reference agent (parse_smi_uuids.py:6-18
+execs ``nvidia-smi -L`` and regexes UUIDs). The seam is the binary path /
+fake-metrics file, so everything is testable without TPU hardware
+(SURVEY.md hard part f)."""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+from typing import List, Optional
+
+from ..registry.inventory import ChipInfo
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def probe_binary_path() -> str:
+    """Default location of the built prober (make -C native/tpuprobe)."""
+    return os.environ.get(
+        "TPUPROBE_BIN",
+        os.path.join(_HERE, "..", "..", "native", "tpuprobe", "tpuprobe"),
+    )
+
+
+class Scraper:
+    def __init__(self, binary: Optional[str] = None, fake_file: Optional[str] = None,
+                 timeout_s: float = 5.0):
+        self.binary = binary or probe_binary_path()
+        self.fake_file = fake_file or os.environ.get("TPUPROBE_FAKE")
+        self.timeout_s = timeout_s
+
+    def scrape(self) -> List[ChipInfo]:
+        """One probe → chip list. Raises RuntimeError when the prober is
+        missing or emits garbage (the agent loop logs and retries — the
+        reference's loop just re-execs every 2 s)."""
+        argv = [self.binary, "--once"]
+        if self.fake_file:
+            argv += ["--fake", self.fake_file]
+        try:
+            proc = subprocess.run(
+                argv, capture_output=True, timeout=self.timeout_s, check=False
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise RuntimeError(f"tpuprobe failed: {e}") from e
+        if proc.returncode != 0:
+            # exit 1 = probe found NO devices (tpuprobe.cpp) — a broken node
+            # must not masquerade as a fully idle one (utilization 0 would
+            # make it the top-scored target).
+            raise RuntimeError(
+                f"tpuprobe exit {proc.returncode}: {proc.stderr.decode()!r}"
+            )
+        try:
+            doc = json.loads(proc.stdout.decode() or "{}")
+        except ValueError as e:
+            raise RuntimeError(f"tpuprobe emitted non-JSON: {proc.stdout!r}") from e
+        chips = []
+        for c in doc.get("chips", []):
+            chips.append(ChipInfo(
+                device_id=int(c.get("device_id", 0)),
+                duty_cycle=float(c.get("duty_cycle", 0.0)),
+                hbm_used_bytes=int(c.get("hbm_used", 0)),
+                hbm_total_bytes=int(c.get("hbm_total", 0)),
+            ))
+        return chips

@@ -31,7 +31,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol, Tuple
 
-from ..api.objects import Pod
+from ..api.objects import ANN_RESHAPE_STATE, Pod
 from ..api.topology import SliceTopology, TPUGen, chip_count, parse_topology
 from ..registry.inventory import NodeInventory, read_inventory
 from ..sched.cache import NodeInfo
@@ -171,11 +171,13 @@ class TPUPlugin(
         registry: Optional[InventorySource] = None,
         prom=None,
         recommender: Optional[PredictionClient] = None,
+        reshaper=None,
     ) -> None:
         self.handle = handle
         self.registry = registry
         self.prom = prom
         self.recommender = recommender
+        self.reshaper = reshaper
         self.weight = handle.config.tpu_score_weight
 
     # -- PreFilter ---------------------------------------------------------
@@ -197,6 +199,11 @@ class TPUPlugin(
                 return Status.unschedulable(f"node selector {k}={v} not matched")
         if "Ready" not in info.node.status.conditions:
             return Status.unschedulable("node not Ready")
+        if info.node.metadata.annotations.get(ANN_RESHAPE_STATE) == "applying":
+            # Chips are in flux mid-repartition — the reference instead
+            # BLOCKS the scheduling thread through the whole MIG reconfig
+            # (gpu_plugins.go:436-452); we skip the node and keep scheduling.
+            return Status.unschedulable("slice repartition in progress")
         chips = pod.spec.tpu_chips()
         if chips == 0:
             # CPU-only pod (busybox smoke, BASELINE config 1) — any Ready
@@ -254,8 +261,33 @@ class TPUPlugin(
             except Exception as e:  # noqa: BLE001
                 log.warning("reserve-time decide(%s) degraded: %s", node_name, e)
                 decision = Decision(node_name=node_name)
+        reshape = self._maybe_reshape(state, pod, node_name, decision)
+        if reshape is not None:
+            return reshape
         state.write("tpu.reserved", decision)
         return Status.success()
+
+    def _maybe_reshape(
+        self, state: CycleState, pod: Pod, node_name: str, decision: Decision
+    ) -> Optional[Status]:
+        """Empty winning node whose partitioning can't serve this pod's SLO:
+        kick off the ASYNC repartition and requeue the pod (reconfigure
+        parity, gpu_plugins.go:357-452 — triggered on an empty A30 — minus
+        its scheduling-thread block). The pod retries via backoff and lands
+        once the agent confirms the new layout."""
+        if self.reshaper is None or not decision.rightsized_config:
+            return None
+        info: Optional[NodeInfo] = state.read(f"tpu.nodeinfo/{node_name}")
+        if info is None or any(p.spec.tpu_chips() > 0 for p in info.pods):
+            return None  # only idle hosts repartition (reference parity)
+        current = decision.partition.topology if decision.partition else ""
+        if decision.rightsized_config == current:
+            return None
+        if self.reshaper.request(node_name, decision.rightsized_config):
+            return Status.unschedulable(
+                f"repartitioning {node_name} to {decision.rightsized_config}"
+            )
+        return None
 
     def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
         state.write("tpu.reserved", None)
@@ -333,7 +365,7 @@ class TPUPlugin(
 
         score, best = self._slo_score(info, topo, partitions, pod, slo, chips_wanted)
         decision.partition = best or self._pick_free_partition(info, partitions, chips_wanted)
-        decision.rightsized_config = self._rightsize(topo, slo)
+        decision.rightsized_config = self._rightsize(topo, slo, chips_wanted)
         self._fill_sharing_limits(decision, topo, partitions)
         return decision, score
 
@@ -415,11 +447,13 @@ class TPUPlugin(
                 best_score, best_part = part_score, part
         return best_score, best_part
 
-    def _rightsize(self, topo: SliceTopology, slo: float) -> str:
+    def _rightsize(self, topo: SliceTopology, slo: float, chips_wanted: int) -> str:
         """Cheapest partitioning that still meets the SLO — V100/MPS
         right-sizing parity (gpu_plugins.go:638-666), smallest sub-slice
         preferred (the reference prefers the *lowest predicted QPS* that
-        still clears the SLO)."""
+        still clears the SLO). Sub-slices smaller than the pod's own chip
+        request are never candidates — repartitioning a node so the
+        triggering pod can't fit would strand it."""
         if self.recommender is None:
             return ""
         from ..api.topology import SLICE_CONFIGS
@@ -427,6 +461,8 @@ class TPUPlugin(
         gen = gen_short(topo.gen)
         best_cfg, best_pred = "", -1.0
         for cfg, parts in SLICE_CONFIGS[topo.gen]:
+            if chip_count(parse_topology(cfg)) < chips_wanted:
+                continue
             preds = self.recommender.impute_configurations(cfg)
             pred = preds.get(f"{parts}P_{gen}")
             if pred is None:

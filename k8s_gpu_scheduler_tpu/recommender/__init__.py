@@ -1,0 +1,23 @@
+"""Prediction service — throughput/interference imputation over gRPC.
+
+Parity with the reference's recommender stack (C8-C13, SURVEY.md §2):
+same wire protocol (protos/recom.proto — package/service ``recommender``,
+``ImputeConfigurations``/``ImputeInterference``), same serving behavior
+(substring index lookup with '-'→'_' normalization, md5-watched background
+retrain with atomic model swap), re-keyed for TPUs: configuration columns
+are ``{parts}P_{gen}`` (e.g. ``4P_V5E`` = 4-way-partitioned v5e host) and
+interference rows are ``{workload}_{gen}``.
+
+Original implementation differences (deliberate):
+- messages are encoded with a 40-line hand-rolled proto3 wire codec
+  (wire.py) served through grpc generic handlers — no codegen toolchain in
+  the serving path, still byte-compatible with the reference's stubs;
+- the imputer is a numpy iterative ridge-regression (MICE-style) model
+  (model.py) instead of a scikit-learn import — deterministic, hermetic,
+  dependency-free.
+"""
+from .client import Client, find_max_index
+from .model import IterativeImputer
+from .server import RecommenderServer
+
+__all__ = ["Client", "find_max_index", "IterativeImputer", "RecommenderServer"]

@@ -1,0 +1,64 @@
+"""gRPC client — C8 parity (go_client/pkg/client_call.go:11-37), returning
+column→value dicts that satisfy plugins.tpu.PredictionClient directly.
+
+Unlike the reference's dial-per-call clients, one channel persists for the
+client's lifetime (the scoring hot loop makes 2 calls per resident pod —
+re-dialing each would dominate the cycle)."""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from .wire import (
+    METHOD_CONFIGURATIONS,
+    METHOD_INTERFERENCE,
+    decode_reply,
+    encode_request,
+)
+
+
+class Client:
+    def __init__(self, host: str = "127.0.0.1", port: int = 32700,
+                 timeout_s: float = 2.0):
+        import grpc
+
+        self._timeout = timeout_s
+        self._channel = grpc.insecure_channel(f"{host}:{port}")
+        self._conf = self._channel.unary_unary(
+            METHOD_CONFIGURATIONS,
+            request_serializer=encode_request,
+            response_deserializer=decode_reply,
+        )
+        self._intf = self._channel.unary_unary(
+            METHOD_INTERFERENCE,
+            request_serializer=encode_request,
+            response_deserializer=decode_reply,
+        )
+
+    def impute_configurations(self, index: str) -> Dict[str, float]:
+        result, columns = self._conf(index, timeout=self._timeout)
+        return dict(zip(columns, result))
+
+    def impute_interference(self, index: str) -> Dict[str, float]:
+        result, columns = self._intf(index, timeout=self._timeout)
+        return dict(zip(columns, result))
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self) -> "Client":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def find_max_index(predictions: Dict[str, float], substring: str = "") -> Optional[Tuple[str, float]]:
+    """Highest-valued column (optionally filtered by substring) — parity with
+    FindMaxIndForNode (go_client/utils/utils.go:9-18)."""
+    best: Optional[Tuple[str, float]] = None
+    for col, val in predictions.items():
+        if substring and substring not in col:
+            continue
+        if best is None or val > best[1]:
+            best = (col, val)
+    return best

@@ -19,6 +19,7 @@ from .framework import (
     WaitingPod,
 )
 from .queue import SchedulingQueue, pod_priority
+from .reshaper import SliceReshaper
 from .scheduler import Scheduler
 
 __all__ = [
@@ -40,5 +41,6 @@ __all__ = [
     "WaitingPod",
     "SchedulingQueue",
     "pod_priority",
+    "SliceReshaper",
     "Scheduler",
 ]

@@ -1,0 +1,145 @@
+// tpuprobe — native TPU inventory/utilization prober.
+//
+// The REAL equivalent of the reference's vestigial CUDA probe
+// (pkg/profiler/gpu_profiling.cpp:10-23 — free/total memory + SM count,
+// never built: pkg/profiler/Makefile:13-14). This one is built and used:
+// the node agent (k8s_gpu_scheduler_tpu/agent) execs it the way the
+// reference's DaemonSet execs nvidia-smi (profile_gpu.sh:3-13,
+// parse_smi_uuids.py:6), and parses one JSON object per probe from stdout.
+//
+// Probe sources, in order:
+//   1. --fake FILE / TPUPROBE_FAKE: a JSON metrics file — the fake-libtpu
+//      test seam (SURVEY.md hard part f: buildable + testable without TPU
+//      hardware). The file is passed through after validation.
+//   2. /dev/accel* (or TPUPROBE_DEV_GLOB): the accelerator device nodes a
+//      GKE TPU VM exposes; one chip per node, utilization unknown (0) —
+//      live duty cycle comes from the metrics layer, not the prober.
+//
+// Output schema (one line):
+//   {"chips":[{"device_id":N,"duty_cycle":F,"hbm_used":N,"hbm_total":N}]}
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <glob.h>
+#include <string>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Chip {
+  int device_id = 0;
+  double duty_cycle = 0.0;
+  long long hbm_used = 0;
+  long long hbm_total = 0;
+};
+
+void emit(const std::vector<Chip>& chips) {
+  std::string out = "{\"chips\":[";
+  char buf[160];
+  for (size_t i = 0; i < chips.size(); ++i) {
+    const Chip& c = chips[i];
+    snprintf(buf, sizeof buf,
+             "%s{\"device_id\":%d,\"duty_cycle\":%.4f,\"hbm_used\":%lld,"
+             "\"hbm_total\":%lld}",
+             i ? "," : "", c.device_id, c.duty_cycle, c.hbm_used, c.hbm_total);
+    out += buf;
+  }
+  out += "]}\n";
+  fputs(out.c_str(), stdout);
+  fflush(stdout);
+}
+
+// Minimal field scanner for the fake file: pulls every {...} object's
+// device_id/duty_cycle/hbm_* numbers. Tolerant of whitespace/ordering;
+// anything unparsable yields no chips (exit 1 below).
+bool parse_fake(const std::string& text, std::vector<Chip>* chips) {
+  size_t pos = 0;
+  while ((pos = text.find("\"device_id\"", pos)) != std::string::npos) {
+    Chip c;
+    auto grab = [&](const char* key, double* out_d, long long* out_ll) {
+      size_t start = text.rfind('{', pos);
+      size_t end = text.find('}', pos);
+      if (start == std::string::npos || end == std::string::npos) return;
+      size_t k = text.find(key, start);
+      if (k == std::string::npos || k > end) return;
+      size_t colon = text.find(':', k);
+      if (colon == std::string::npos || colon > end) return;
+      const char* s = text.c_str() + colon + 1;
+      if (out_d) *out_d = strtod(s, nullptr);
+      if (out_ll) *out_ll = strtoll(s, nullptr, 10);
+    };
+    double id = 0;
+    grab("\"device_id\"", &id, nullptr);
+    c.device_id = static_cast<int>(id);
+    grab("\"duty_cycle\"", &c.duty_cycle, nullptr);
+    grab("\"hbm_used\"", nullptr, &c.hbm_used);
+    grab("\"hbm_total\"", nullptr, &c.hbm_total);
+    chips->push_back(c);
+    pos = text.find('}', pos);
+    if (pos == std::string::npos) break;
+  }
+  return !chips->empty();
+}
+
+bool probe_fake(const char* path, std::vector<Chip>* chips) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  std::string text;
+  char buf[4096];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof buf, f)) > 0) text.append(buf, n);
+  fclose(f);
+  return parse_fake(text, chips);
+}
+
+bool probe_devnodes(const char* pattern, std::vector<Chip>* chips) {
+  glob_t g;
+  if (glob(pattern, 0, nullptr, &g) != 0) return false;
+  for (size_t i = 0; i < g.gl_pathc; ++i) {
+    Chip c;
+    // device id = trailing integer of the node name (accel3 -> 3)
+    const char* name = g.gl_pathv[i];
+    const char* p = name + strlen(name);
+    while (p > name && isdigit(static_cast<unsigned char>(p[-1]))) --p;
+    c.device_id = atoi(p);
+    chips->push_back(c);
+  }
+  globfree(&g);
+  return !chips->empty();
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* fake = getenv("TPUPROBE_FAKE");
+  const char* dev_glob = getenv("TPUPROBE_DEV_GLOB");
+  int interval_s = 0;  // 0 = --once
+  for (int i = 1; i < argc; ++i) {
+    if (!strcmp(argv[i], "--fake") && i + 1 < argc) fake = argv[++i];
+    else if (!strcmp(argv[i], "--interval") && i + 1 < argc)
+      interval_s = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--once")) interval_s = 0;
+    else if (!strcmp(argv[i], "--help")) {
+      puts("tpuprobe [--once] [--interval SECONDS] [--fake FILE]");
+      return 0;
+    }
+  }
+  if (!dev_glob) dev_glob = "/dev/accel*";
+
+  do {
+    std::vector<Chip> chips;
+    bool ok = fake ? probe_fake(fake, &chips) : probe_devnodes(dev_glob, &chips);
+    if (!ok && !fake) ok = probe_fake("/tmp/tpuprobe_fake.json", &chips);
+    if (!ok) {
+      fputs("{\"chips\":[]}\n", stdout);
+      fflush(stdout);
+      if (interval_s == 0) return 1;
+    } else {
+      emit(chips);
+    }
+    if (interval_s > 0) sleep(interval_s);
+  } while (interval_s > 0);
+  return 0;
+}

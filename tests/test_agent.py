@@ -1,0 +1,180 @@
+"""Node-agent tests: the BUILT C++ prober over its fake seam, scrape
+parsing, change-detected publishing, and the full agent→registry→scheduler
+integration (Score consumes agent-published utilization)."""
+import json
+import os
+import subprocess
+import time
+
+import pytest
+
+from k8s_gpu_scheduler_tpu.agent import Publisher, Scraper
+from k8s_gpu_scheduler_tpu.registry.inventory import (
+    NodeInventory,
+    node_key,
+    read_inventory,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PROBE_DIR = os.path.join(HERE, "..", "native", "tpuprobe")
+PROBE_BIN = os.path.join(PROBE_DIR, "tpuprobe")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_probe():
+    subprocess.run(["make", "-C", PROBE_DIR], check=True, capture_output=True)
+    assert os.path.exists(PROBE_BIN)
+
+
+def write_fake(tmp_path, chips):
+    path = tmp_path / "fake.json"
+    path.write_text(json.dumps({"chips": chips}))
+    return str(path)
+
+
+class MemRegistry:
+    def __init__(self):
+        self.data = {}
+
+    def set(self, key, value):
+        self.data[key] = value
+
+    def get(self, key):
+        return self.data.get(key)
+
+    def get_keys(self, pattern="*"):
+        return [k for k in self.data if k.startswith(pattern.rstrip("*"))]
+
+
+class TestProber:
+    def test_fake_seam_roundtrip(self, tmp_path):
+        fake = write_fake(tmp_path, [
+            {"device_id": 0, "duty_cycle": 0.75, "hbm_used": 8, "hbm_total": 16},
+            {"device_id": 1, "duty_cycle": 0.25, "hbm_used": 4, "hbm_total": 16},
+        ])
+        out = subprocess.run([PROBE_BIN, "--once", "--fake", fake],
+                             capture_output=True, check=True)
+        doc = json.loads(out.stdout)
+        assert [c["device_id"] for c in doc["chips"]] == [0, 1]
+        assert doc["chips"][0]["duty_cycle"] == pytest.approx(0.75)
+
+    def test_no_devices_empty_and_nonzero_exit(self, tmp_path):
+        env = {**os.environ, "TPUPROBE_DEV_GLOB": str(tmp_path / "nope*")}
+        out = subprocess.run([PROBE_BIN, "--once"], capture_output=True, env=env)
+        assert out.returncode == 1
+        assert json.loads(out.stdout) == {"chips": []}
+
+    def test_devnode_enumeration(self, tmp_path):
+        for i in (0, 1, 3):
+            (tmp_path / f"accel{i}").touch()
+        env = {**os.environ, "TPUPROBE_DEV_GLOB": str(tmp_path / "accel*")}
+        out = subprocess.run([PROBE_BIN, "--once"], capture_output=True,
+                             env=env, check=True)
+        ids = [c["device_id"] for c in json.loads(out.stdout)["chips"]]
+        assert ids == [0, 1, 3]
+
+
+class TestScraper:
+    def test_scrape_parses_chips(self, tmp_path):
+        fake = write_fake(tmp_path, [
+            {"device_id": 2, "duty_cycle": 0.5, "hbm_used": 1, "hbm_total": 2},
+        ])
+        chips = Scraper(binary=PROBE_BIN, fake_file=fake).scrape()
+        assert len(chips) == 1
+        assert chips[0].device_id == 2
+        assert chips[0].duty_cycle == 0.5
+
+    def test_missing_binary_raises(self):
+        with pytest.raises(RuntimeError):
+            Scraper(binary="/nonexistent/tpuprobe").scrape()
+
+
+class TestPublisher:
+    def _publisher(self, tmp_path, reg, duty=0.5):
+        fake = write_fake(tmp_path, [
+            {"device_id": i, "duty_cycle": duty, "hbm_used": 0,
+             "hbm_total": 16 << 30} for i in range(4)
+        ])
+        return Publisher(
+            reg,
+            scraper=Scraper(binary=PROBE_BIN, fake_file=fake),
+            node_name="w0",
+            accelerator="tpu-v5-lite-podslice",
+            topology="2x4",
+            interval_s=0.05,
+            heartbeat_s=60,
+        ), fake
+
+    def test_publish_once_and_change_detection(self, tmp_path):
+        reg = MemRegistry()
+        pub, fake = self._publisher(tmp_path, reg)
+        assert pub.publish_once() is True
+        inv = read_inventory(reg, "w0")
+        assert inv.utilization == pytest.approx(0.5)
+        assert len(inv.chips) == 4
+        assert inv.topology == "2x4"
+        # Unchanged scrape within heartbeat → no write.
+        assert pub.publish_once() is False
+        # Changed duty → write.
+        with open(fake, "w") as f:
+            json.dump({"chips": [
+                {"device_id": i, "duty_cycle": 0.9, "hbm_used": 0,
+                 "hbm_total": 16 << 30} for i in range(4)
+            ]}, f)
+        assert pub.publish_once() is True
+        assert read_inventory(reg, "w0").utilization == pytest.approx(0.9)
+
+    def test_heartbeat_key_written(self, tmp_path):
+        reg = MemRegistry()
+        pub, _ = self._publisher(tmp_path, reg)
+        pub.publish_once()
+        assert node_key("w0") + "/heartbeat" in reg.data
+
+    def test_loop_publishes(self, tmp_path):
+        reg = MemRegistry()
+        pub, _ = self._publisher(tmp_path, reg)
+        pub.start()
+        try:
+            deadline = time.time() + 5
+            while time.time() < deadline and node_key("w0") not in reg.data:
+                time.sleep(0.02)
+            assert node_key("w0") in reg.data
+        finally:
+            pub.stop()
+
+
+class TestAgentSchedulerIntegration:
+    def test_score_consumes_agent_utilization(self, tmp_path):
+        """VERDICT item 6 'done' criterion: agent publishes, Score reads —
+        the idle node (agent-reported) wins over the busy one."""
+        from k8s_gpu_scheduler_tpu.cluster import APIServer
+        from k8s_gpu_scheduler_tpu.config import SchedulerConfig
+        from k8s_gpu_scheduler_tpu.plugins import TPUPlugin
+        from k8s_gpu_scheduler_tpu.sched import CycleState, Profile, Scheduler
+        from tests.test_plugins import mk_node, mk_pod
+
+        reg = MemRegistry()
+        for name, duty in [("busy", 0.95), ("idle", 0.05)]:
+            fake = write_fake(tmp_path, [
+                {"device_id": i, "duty_cycle": duty, "hbm_used": 0,
+                 "hbm_total": 16 << 30} for i in range(8)
+            ])
+            Publisher(
+                reg, scraper=Scraper(binary=PROBE_BIN, fake_file=fake),
+                node_name=name, accelerator="tpu-v5-lite-podslice",
+                topology="2x4",
+            ).publish_once()
+
+        sched = Scheduler(APIServer(), profile=Profile(), config=SchedulerConfig())
+        plugin = TPUPlugin(sched.handle, registry=reg)
+        for n in ("busy", "idle"):
+            sched.cache.add_node(mk_node(n))
+        state = CycleState()
+        pod = mk_pod("p", chips=1)
+        plugin.pre_filter(state, pod)
+        for n in ("busy", "idle"):
+            assert plugin.filter(state, pod, sched.cache.snapshot()[n]).ok
+        s_busy, _ = plugin.score(state, pod, "busy")
+        s_idle, _ = plugin.score(state, pod, "idle")
+        assert s_idle > s_busy
+        assert s_idle == pytest.approx(95.0)

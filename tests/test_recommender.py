@@ -1,0 +1,174 @@
+"""Recommender tests: wire codec golden bytes, imputer recovery, hermetic
+in-process gRPC server+client, retrain-on-change, and the TPU plugin
+consuming the REAL service end to end."""
+import time
+
+import numpy as np
+import pytest
+
+from k8s_gpu_scheduler_tpu.recommender import (
+    Client,
+    IterativeImputer,
+    RecommenderServer,
+    find_max_index,
+)
+from k8s_gpu_scheduler_tpu.recommender.wire import (
+    decode_reply,
+    decode_request,
+    encode_reply,
+    encode_request,
+)
+
+
+class TestWireCodec:
+    def test_request_golden_bytes(self):
+        # proto3: field 1, LEN — tag 0x0A, length, utf8. Byte-compatible
+        # with the reference's Request{index} (recom.proto:10-12).
+        assert encode_request("abc") == b"\x0a\x03abc"
+        assert decode_request(b"\x0a\x03abc") == "abc"
+
+    def test_reply_roundtrip(self):
+        buf = encode_reply([1.5, -2.25], ["1P_V5E", "2P_V5E"])
+        result, columns = decode_reply(buf)
+        assert result == [1.5, -2.25]
+        assert columns == ["1P_V5E", "2P_V5E"]
+
+    def test_reply_golden_packed_floats(self):
+        # packed fixed32: tag 0x0A, len 4, IEEE754 LE of 1.0
+        assert encode_reply([1.0], []) == b"\x0a\x04\x00\x00\x80\x3f"
+
+    def test_empty_reply(self):
+        assert decode_reply(encode_reply([], [])) == ([], [])
+
+    def test_decode_skips_unknown_fields(self):
+        # field 3 varint (tag 0x18) must be skipped, not crash
+        buf = b"\x18\x2a" + encode_reply([2.0], ["c"])
+        result, columns = decode_reply(buf)
+        assert result == [2.0] and columns == ["c"]
+
+
+class TestImputer:
+    def test_recovers_linear_structure(self):
+        # col1 = 2*col0, col2 = col0 + 10 — missing cells must land close.
+        rng = np.random.default_rng(0)
+        base = rng.uniform(1, 100, size=(20, 1))
+        X = np.hstack([base, 2 * base, base + 10])
+        X_missing = X.copy()
+        X_missing[3, 1] = np.nan
+        X_missing[7, 2] = np.nan
+        imp = IterativeImputer()
+        done = imp.fit_transform(X_missing)
+        assert done[3, 1] == pytest.approx(X[3, 1], rel=0.05)
+        assert done[7, 2] == pytest.approx(X[7, 2], rel=0.05)
+
+    def test_transform_unseen_row(self):
+        rng = np.random.default_rng(1)
+        base = rng.uniform(1, 100, size=(20, 1))
+        imp = IterativeImputer().fit(np.hstack([base, 3 * base]))
+        row = np.array([[50.0, np.nan]])
+        assert imp.transform(row)[0, 1] == pytest.approx(150.0, rel=0.05)
+
+    def test_all_nan_column_mean_zero(self):
+        X = np.array([[1.0, np.nan], [2.0, np.nan]])
+        done = IterativeImputer().fit_transform(X)
+        assert np.isfinite(done).all()
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    data = os.path.join(
+        here, "..", "k8s_gpu_scheduler_tpu", "recommender", "data"
+    )
+    srv = RecommenderServer(
+        configurations_path=os.path.join(data, "configurations_train.tsv"),
+        interference_path=os.path.join(data, "interference_train.tsv"),
+        port=0,
+        retrain_interval_s=0.2,
+    ).start()
+    yield srv
+    srv.stop()
+
+
+class TestService:
+    def test_configurations_by_pod_name_substring(self, server):
+        """Pod-style request 'bert-base-infer-7f9c' must hit the
+        'bert_base_infer' row ('-'→'_' normalization parity)."""
+        with Client(port=server.port) as c:
+            preds = c.impute_configurations("bert-base-infer-7f9c")
+        assert preds["1P_V5E"] == pytest.approx(3900.0)
+        # The blank 4P_V5P cell was imputed to something finite/positive.
+        assert np.isfinite(preds["4P_V5P"]) and preds["4P_V5P"] > 0
+
+    def test_interference_keyed_by_workload_gen(self, server):
+        with Client(port=server.port) as c:
+            row = c.impute_interference("llama3-8b-serve-0_V5E")
+        assert row["resnet50_train"] == pytest.approx(118.0)
+
+    def test_unknown_workload_empty_reply(self, server):
+        with Client(port=server.port) as c:
+            assert c.impute_configurations("nosuch-workload") == {}
+
+    def test_find_max_index(self):
+        preds = {"1P_V5E": 100.0, "2P_V5E": 60.0, "1P_V5P": 150.0}
+        assert find_max_index(preds) == ("1P_V5P", 150.0)
+        assert find_max_index(preds, "V5E") == ("1P_V5E", 100.0)
+
+    def test_plugin_consumes_real_service(self, server):
+        """The gRPC client satisfies plugins.tpu.PredictionClient: the
+        SLO-slack scorer runs against the live server."""
+        from k8s_gpu_scheduler_tpu.api.objects import (
+            Container, EnvVar, PodSpec, Pod, ObjectMeta, ResourceRequirements,
+            TPU_RESOURCE,
+        )
+        from k8s_gpu_scheduler_tpu.cluster import APIServer
+        from k8s_gpu_scheduler_tpu.config import SchedulerConfig
+        from k8s_gpu_scheduler_tpu.plugins import TPUPlugin
+        from k8s_gpu_scheduler_tpu.sched import CycleState, Profile, Scheduler
+        from tests.test_plugins import FakeRegistry, mk_node
+
+        reg = FakeRegistry()
+        reg.publish("n1", utilization=0.0)
+        sched = Scheduler(APIServer(), profile=Profile(),
+                          config=SchedulerConfig())
+        with Client(port=server.port) as rec:
+            plugin = TPUPlugin(sched.handle, registry=reg, recommender=rec)
+            sched.cache.add_node(mk_node("n1"))
+            state = CycleState()
+            pod = Pod(
+                metadata=ObjectMeta(name="bert-base-infer-0"),
+                spec=PodSpec(containers=[Container(
+                    env=[EnvVar("SLO", "2000")],
+                    resources=ResourceRequirements(requests={TPU_RESOURCE: 8}),
+                )]),
+            )
+            plugin.pre_filter(state, pod)
+            assert plugin.filter(state, pod, sched.cache.snapshot()["n1"]).ok
+            score, st = plugin.score(state, pod, "n1")
+            assert st.ok
+            # 1P_V5E predicts 3900 vs SLO 2000 → satisfied → positive score.
+            assert score > 50
+
+
+class TestRetrain:
+    def test_md5_watch_hot_swap(self, tmp_path):
+        conf = tmp_path / "conf.tsv"
+        intf = tmp_path / "intf.tsv"
+        conf.write_text("workload\t1P_V5E\njob_a\t100\n")
+        intf.write_text("pair\tjob_a\njob_a_V5E\t5\n")
+        srv = RecommenderServer(str(conf), str(intf), port=0,
+                                retrain_interval_s=0.05).start()
+        try:
+            with Client(port=srv.port) as c:
+                assert c.impute_configurations("job_a")["1P_V5E"] == 100.0
+                conf.write_text("workload\t1P_V5E\njob_a\t250\n")
+                deadline = time.time() + 5
+                while time.time() < deadline:
+                    if c.impute_configurations("job_a")["1P_V5E"] == 250.0:
+                        break
+                    time.sleep(0.05)
+                assert c.impute_configurations("job_a")["1P_V5E"] == 250.0
+        finally:
+            srv.stop()
