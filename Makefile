@@ -1,0 +1,44 @@
+# Build/test/install — C24 parity (root Makefile + pkg Makefiles +
+# install.sh in the reference). `make all` = build native + test, the
+# development loop; image/deploy targets mirror the reference's
+# docker-build-then-kubectl-apply flow (install.sh:5-17).
+PY ?= python
+IMG_TAG ?= 0.1.0
+
+.PHONY: all native test bench demo images install uninstall clean
+
+all: native test
+
+native:
+	$(MAKE) -C native/kvstore
+	$(MAKE) -C native/tpuprobe
+
+test: native
+	$(PY) -m pytest tests/
+
+bench:
+	$(PY) bench.py
+
+demo: native
+	$(PY) -m k8s_gpu_scheduler_tpu.cmd.scheduler --demo 8 --once --metrics-port 0
+
+images:
+	docker build -f docker/Dockerfile.scheduler -t tpu-scheduler:$(IMG_TAG) .
+	docker build -f docker/Dockerfile.agent -t tpu-agent:$(IMG_TAG) .
+	docker build -f docker/Dockerfile.registry -t tpu-registry:$(IMG_TAG) .
+	docker build -f docker/Dockerfile.recommender -t tpu-recommender:$(IMG_TAG) .
+	docker build -f docker/Dockerfile.workloads -t tpu-workloads:$(IMG_TAG) .
+
+install:
+	./install.sh
+
+uninstall:
+	kubectl delete -f deploy/workloads/ --ignore-not-found
+	kubectl delete -f deploy/scheduler/ --ignore-not-found
+	kubectl delete -f deploy/recommender/ --ignore-not-found
+	kubectl delete -f deploy/agent/ --ignore-not-found
+	kubectl delete -f deploy/registry/ --ignore-not-found
+
+clean:
+	$(MAKE) -C native/kvstore clean
+	$(MAKE) -C native/tpuprobe clean

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Install flow — parity with the reference's install.sh:1-17
+# (redis → profiler → scheduler, each: build image, kubectl apply).
+# Ours: registry → agent → recommender → scheduler (+ CRD), then workloads
+# are applied by hand per BASELINE config.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+make images
+
+kubectl apply -f deploy/registry/
+kubectl apply -f deploy/agent/
+# Training matrices from the repo (overrides the seed ConfigMap in the
+# manifest so repo data updates flow through the md5-watch retrain).
+kubectl apply -f deploy/recommender/
+kubectl create configmap recommender-train-data \
+  --namespace recommender \
+  --from-file=k8s_gpu_scheduler_tpu/recommender/data/ \
+  --dry-run=client -o yaml | kubectl apply -f -
+kubectl apply -f deploy/scheduler/podgroup-crd.yaml
+kubectl apply -f deploy/scheduler/rbac.yaml
+kubectl apply -f deploy/scheduler/scheduler.yaml
+
+echo "tpu-scheduler installed. Try: kubectl apply -f deploy/workloads/busybox.yaml"

@@ -1,0 +1,412 @@
+"""Kubernetes REST adapter — APIServer-compatible client for real clusters.
+
+The reference talks to kube-apiserver through client-go; our control plane
+talks to the ``cluster.APIServer`` interface (create/get/list/mutate/delete/
+watch). This module implements that interface over the Kubernetes REST API
+with nothing but the standard library, so the SAME Scheduler/informers/
+plugins run unchanged in-cluster (``cmd.scheduler --in-cluster``):
+
+- auth: in-cluster service-account token + CA
+  (/var/run/secrets/kubernetes.io/serviceaccount) or explicit
+  ``base_url``/``token`` (tests drive a fake HTTP apiserver);
+- objects: k8s JSON ↔ the typed model in api/objects.py (Pod, Node,
+  ConfigMap, and the PodGroup CRD at scheduling.tpu.dev/v1);
+- watch: chunked streaming GET (?watch=1&resourceVersion=N) per kind, one
+  reader thread feeding the same Watch queue contract the informers expect;
+- binding: setting spec.nodeName is rejected by a real apiserver, so
+  ``mutate`` detects the bind pattern and POSTs a Binding subresource
+  instead (what kube-scheduler itself does).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import ssl
+import threading
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+from ..api.objects import (
+    ConfigMap,
+    ConfigMapRef,
+    Container,
+    EnvVar,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodSpec,
+    PodStatus,
+    ResourceRequirements,
+)
+from .apiserver import AlreadyExists, Conflict, NotFound, WatchEvent
+
+log = logging.getLogger(__name__)
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# kind -> (api prefix, plural, namespaced)
+_ROUTES = {
+    "Pod": ("/api/v1", "pods", True),
+    "Node": ("/api/v1", "nodes", False),
+    "ConfigMap": ("/api/v1", "configmaps", True),
+    "PodGroup": ("/apis/scheduling.tpu.dev/v1", "podgroups", True),
+}
+
+
+# -- JSON ↔ typed objects -----------------------------------------------------
+
+def _meta_from(d: Dict) -> ObjectMeta:
+    rv = d.get("resourceVersion", 0)
+    return ObjectMeta(
+        name=d.get("name", ""),
+        namespace=d.get("namespace", "default"),
+        labels=d.get("labels") or {},
+        annotations=d.get("annotations") or {},
+        uid=d.get("uid") or d.get("name", ""),
+        resource_version=int(rv) if str(rv).isdigit() else 0,
+    )
+
+
+def _meta_to(m: ObjectMeta, namespaced: bool) -> Dict:
+    out: Dict[str, Any] = {"name": m.name, "labels": m.labels,
+                           "annotations": m.annotations}
+    if namespaced:
+        out["namespace"] = m.namespace
+    return out
+
+
+def _quantity(v) -> float:
+    """k8s quantity → float (chips are integers; tolerate '4' and 4)."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def pod_from_json(d: Dict) -> Pod:
+    spec = d.get("spec") or {}
+    containers = []
+    for c in spec.get("containers", []):
+        res = c.get("resources") or {}
+        containers.append(Container(
+            name=c.get("name", "main"),
+            image=c.get("image", ""),
+            env=[EnvVar(e["name"], e.get("value", ""))
+                 for e in c.get("env", []) if "name" in e],
+            env_from=[ConfigMapRef(ref["configMapRef"]["name"])
+                      for ref in c.get("envFrom", []) if "configMapRef" in ref],
+            resources=ResourceRequirements(
+                requests={k: _quantity(v)
+                          for k, v in (res.get("requests") or {}).items()},
+                limits={k: _quantity(v)
+                        for k, v in (res.get("limits") or {}).items()},
+            ),
+        ))
+    status = d.get("status") or {}
+    return Pod(
+        metadata=_meta_from(d.get("metadata") or {}),
+        spec=PodSpec(
+            containers=containers,
+            node_name=spec.get("nodeName", ""),
+            scheduler_name=spec.get("schedulerName", "default-scheduler"),
+            node_selector=spec.get("nodeSelector") or {},
+        ),
+        status=PodStatus(
+            phase=status.get("phase", "Pending"),
+            host_ip=status.get("hostIP", ""),
+            pod_ip=status.get("podIP", ""),
+        ),
+    )
+
+
+def node_from_json(d: Dict) -> Node:
+    status = d.get("status") or {}
+    conditions = [c.get("type", "") for c in status.get("conditions", [])
+                  if c.get("status") == "True"]
+    addresses = [a.get("address", "") for a in status.get("addresses", [])]
+    return Node(
+        metadata=_meta_from(d.get("metadata") or {}),
+        status=NodeStatus(
+            capacity={k: _quantity(v)
+                      for k, v in (status.get("capacity") or {}).items()},
+            allocatable={k: _quantity(v)
+                         for k, v in (status.get("allocatable") or {}).items()},
+            addresses=addresses,
+            conditions=conditions or ["Ready"],
+        ),
+    )
+
+
+def configmap_from_json(d: Dict) -> ConfigMap:
+    return ConfigMap(metadata=_meta_from(d.get("metadata") or {}),
+                     data=dict(d.get("data") or {}))
+
+
+def podgroup_from_json(d: Dict) -> PodGroup:
+    spec = d.get("spec") or {}
+    return PodGroup(
+        metadata=_meta_from(d.get("metadata") or {}),
+        min_member=int(spec.get("minMember", 1)),
+        topology=spec.get("topology", ""),
+        schedule_timeout_s=float(spec.get("scheduleTimeoutSeconds", 60)),
+    )
+
+
+def obj_to_json(obj: Any) -> Dict:
+    kind = obj.kind
+    _, _, namespaced = _ROUTES[kind]
+    meta = _meta_to(obj.metadata, namespaced)
+    if kind == "Pod":
+        return {
+            "apiVersion": "v1", "kind": "Pod", "metadata": meta,
+            "spec": {
+                "schedulerName": obj.spec.scheduler_name,
+                "nodeName": obj.spec.node_name or None,
+                "nodeSelector": obj.spec.node_selector,
+                "containers": [{
+                    "name": c.name, "image": c.image,
+                    "env": [{"name": e.name, "value": e.value} for e in c.env],
+                    "envFrom": [{"configMapRef": {"name": r.name}}
+                                for r in c.env_from],
+                    "resources": {
+                        "requests": {k: str(int(v)) for k, v in
+                                     c.resources.requests.items()},
+                        "limits": {k: str(int(v)) for k, v in
+                                   c.resources.limits.items()},
+                    },
+                } for c in obj.spec.containers],
+            },
+        }
+    if kind == "ConfigMap":
+        return {"apiVersion": "v1", "kind": "ConfigMap", "metadata": meta,
+                "data": obj.data}
+    if kind == "Node":
+        return {"apiVersion": "v1", "kind": "Node", "metadata": meta}
+    if kind == "PodGroup":
+        return {
+            "apiVersion": "scheduling.tpu.dev/v1", "kind": "PodGroup",
+            "metadata": meta,
+            "spec": {"minMember": obj.min_member, "topology": obj.topology,
+                     "scheduleTimeoutSeconds": int(obj.schedule_timeout_s)},
+        }
+    raise TypeError(f"unsupported kind {kind}")
+
+
+_FROM_JSON = {
+    "Pod": pod_from_json,
+    "Node": node_from_json,
+    "ConfigMap": configmap_from_json,
+    "PodGroup": podgroup_from_json,
+}
+
+
+# -- the adapter --------------------------------------------------------------
+
+class KubeAPIServer:
+    """Speaks kube REST; quacks like cluster.APIServer."""
+
+    def __init__(self, base_url: Optional[str] = None,
+                 token: Optional[str] = None,
+                 ca_file: Optional[str] = None,
+                 timeout_s: float = 10.0):
+        if base_url is None:
+            import os
+
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "not in-cluster: KUBERNETES_SERVICE_HOST unset "
+                    "(pass base_url explicitly)"
+                )
+            base_url = f"https://{host}:{port}"
+            token = token or open(f"{SA_DIR}/token").read().strip()
+            ca_file = ca_file or f"{SA_DIR}/ca.crt"
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout_s = timeout_s
+        self._ctx: Optional[ssl.SSLContext] = None
+        if self.base_url.startswith("https"):
+            self._ctx = ssl.create_default_context(
+                cafile=ca_file) if ca_file else ssl.create_default_context()
+
+    # -- HTTP plumbing -----------------------------------------------------
+    def _request(self, method: str, path: str, body: Optional[Dict] = None,
+                 content_type: str = "application/json", stream: bool = False):
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+        )
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=None if stream else self.timeout_s,
+                context=self._ctx,
+            )
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:300]
+            if e.code == 404:
+                raise NotFound(f"{method} {path}: {detail}") from e
+            if e.code == 409:
+                if "AlreadyExists" in detail or method == "POST":
+                    raise AlreadyExists(detail) from e
+                raise Conflict(detail) from e
+            raise RuntimeError(f"{method} {path} -> {e.code}: {detail}") from e
+        if stream:
+            return resp
+        return json.loads(resp.read() or b"{}")
+
+    def _path(self, kind: str, namespace: Optional[str] = None,
+              name: Optional[str] = None, suffix: str = "") -> str:
+        prefix, plural, namespaced = _ROUTES[kind]
+        parts = [prefix]
+        if namespaced and namespace is not None:
+            parts.append(f"namespaces/{namespace}")
+        parts.append(plural)
+        if name:
+            parts.append(name)
+        path = "/".join(parts)
+        return path + suffix
+
+    # -- APIServer interface ----------------------------------------------
+    def create(self, obj: Any) -> Any:
+        kind = obj.kind
+        _, _, namespaced = _ROUTES[kind]
+        ns = obj.metadata.namespace if namespaced else None
+        doc = self._request("POST", self._path(kind, ns), obj_to_json(obj))
+        return _FROM_JSON[kind](doc)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Any:
+        doc = self._request("GET", self._path(kind, namespace, name))
+        return _FROM_JSON[kind](doc)
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_fn: Optional[Callable] = None,
+             field_fn: Optional[Callable] = None) -> List[Any]:
+        # all-namespaces list (the informer's view, like client-go factories)
+        doc = self._request("GET", self._path(kind, namespace))
+        objs = [_FROM_JSON[kind](item) for item in doc.get("items", [])]
+        if label_fn:
+            objs = [o for o in objs if label_fn(o.metadata.labels)]
+        if field_fn:
+            objs = [o for o in objs if field_fn(o)]
+        return objs
+
+    def mutate(self, kind: str, name: str, namespace: str,
+               fn: Callable[[Any], None]) -> Any:
+        current = self.get(kind, name, namespace)
+        before_node = getattr(getattr(current, "spec", None), "node_name", None)
+        fn(current)
+        after_node = getattr(getattr(current, "spec", None), "node_name", None)
+        if kind == "Pod" and not before_node and after_node:
+            # bind: POST the Binding subresource (spec.nodeName is immutable
+            # through PATCH on a real apiserver).
+            self._request(
+                "POST", self._path("Pod", namespace, name, "/binding"),
+                {"apiVersion": "v1", "kind": "Binding",
+                 "metadata": {"name": name},
+                 "target": {"apiVersion": "v1", "kind": "Node",
+                            "name": after_node}},
+            )
+            return current
+        body = obj_to_json(current)
+        if kind == "Node":
+            # only metadata is ours to change on nodes (labels/annotations)
+            body = {"metadata": body["metadata"]}
+        doc = self._request(
+            "PATCH", self._path(kind, namespace, name), body,
+            content_type="application/merge-patch+json",
+        )
+        return _FROM_JSON[kind](doc)
+
+    def update(self, obj: Any, expect_rv: Optional[int] = None) -> Any:
+        kind = obj.kind
+        _, _, namespaced = _ROUTES[kind]
+        ns = obj.metadata.namespace if namespaced else None
+        doc = self._request(
+            "PATCH", self._path(kind, ns, obj.metadata.name), obj_to_json(obj),
+            content_type="application/merge-patch+json",
+        )
+        return _FROM_JSON[kind](doc)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        self._request("DELETE", self._path(kind, namespace, name))
+
+    def watch(self, kind: str, send_initial: bool = True) -> "KubeWatch":
+        return KubeWatch(self, kind, send_initial)
+
+
+class KubeWatch:
+    """Streams watch events for one kind; same next()/stop() contract as
+    cluster.apiserver.Watch (informers consume it unchanged)."""
+
+    def __init__(self, server: KubeAPIServer, kind: str, send_initial: bool):
+        self.server = server
+        self.kind = kind
+        self._q: "queue.Queue" = queue.Queue()
+        self._stopped = threading.Event()
+        rv = "0"
+        if send_initial:
+            doc = server._request("GET", server._path(kind, None))
+            rv = (doc.get("metadata") or {}).get("resourceVersion", "0")
+            for item in doc.get("items", []):
+                self._q.put(WatchEvent("ADDED", _FROM_JSON[kind](item)))
+        self._thread = threading.Thread(
+            target=self._stream, args=(rv,), daemon=True,
+            name=f"kubewatch-{kind}",
+        )
+        self._thread.start()
+
+    def _stream(self, rv: str) -> None:
+        while not self._stopped.is_set():
+            try:
+                path = self.server._path(self.kind, None) + (
+                    f"?watch=1&allowWatchBookmarks=true&resourceVersion={rv}"
+                )
+                resp = self.server._request("GET", path, stream=True)
+                for line in resp:
+                    if self._stopped.is_set():
+                        return
+                    if not line.strip():
+                        continue
+                    ev = json.loads(line)
+                    ev_type = ev.get("type", "")
+                    obj_doc = ev.get("object") or {}
+                    new_rv = (obj_doc.get("metadata") or {}).get(
+                        "resourceVersion")
+                    if new_rv:
+                        rv = new_rv
+                    if ev_type == "BOOKMARK":
+                        continue
+                    if ev_type not in ("ADDED", "MODIFIED", "DELETED"):
+                        continue
+                    self._q.put(WatchEvent(
+                        ev_type, _FROM_JSON[self.kind](obj_doc)))
+            except Exception as e:  # noqa: BLE001 — reconnect with backoff
+                if self._stopped.is_set():
+                    return
+                log.warning("watch %s dropped (%s); reconnecting", self.kind, e)
+                self._stopped.wait(1.0)
+
+    _SENTINEL = object()
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if ev is KubeWatch._SENTINEL:
+            return None  # informer run loops exit on None after stop()
+        return ev
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._q.put(KubeWatch._SENTINEL)

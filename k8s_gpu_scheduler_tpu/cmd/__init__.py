@@ -1,0 +1,3 @@
+"""Executable entrypoints (C1 parity — cmd/scheduler/main.go registers the
+plugin into kube-scheduler and runs it; ours wires the whole control plane).
+Run with ``python -m k8s_gpu_scheduler_tpu.cmd.scheduler``."""

@@ -7,5 +7,15 @@ model zoo: everything jit-compiled, bf16, static-shaped, sharded via
 parallel/ — the flagship (llama) is what __graft_entry__/bench.py drive.
 """
 from .llama import LlamaConfig, init_params, forward, loss_fn, make_train_step
+from .bert import BertConfig
+from .resnet import ResNetConfig
 
-__all__ = ["LlamaConfig", "init_params", "forward", "loss_fn", "make_train_step"]
+__all__ = [
+    "LlamaConfig",
+    "BertConfig",
+    "ResNetConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "make_train_step",
+]

@@ -1,0 +1,128 @@
+"""BERT-base encoder — the bin-packed inference workload (BASELINE config 3).
+
+Pure-JAX encoder sharing the ops layer with the decoder: non-causal
+dense_attention, learned position embeddings, GELU MLP, LayerNorm (post-LN,
+the original BERT arrangement). Inference-shaped: ``encode`` returns final
+hidden states, ``classify`` a pooled logit head; ``main()`` is the pod
+entrypoint that reports achieved QPS against the SLO env the scheduler
+scored it by.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dense_attention
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq: int = 512
+    n_classes: int = 2
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny() -> "BertConfig":
+        return BertConfig(vocab=128, d_model=32, n_layers=2, n_heads=4,
+                          d_ff=64, max_seq=64)
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+               eps: float = 1e-12) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale + bias
+
+
+def init_params(cfg: BertConfig, key: jax.Array) -> Dict:
+    ks = jax.random.split(key, 8)
+    D, H, hd, F, L = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers
+
+    def norm(k, *shape):
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(cfg.dtype)
+
+    return {
+        "tok_embed": norm(ks[0], cfg.vocab, D),
+        "pos_embed": norm(ks[1], cfg.max_seq, D),
+        "blocks": {
+            "wqkv": norm(ks[2], L, D, 3 * H * hd),
+            "wo": norm(ks[3], L, H * hd, D),
+            "ln1_s": jnp.ones((L, D), cfg.dtype),
+            "ln1_b": jnp.zeros((L, D), cfg.dtype),
+            "w1": norm(ks[4], L, D, F),
+            "w2": norm(ks[5], L, F, D),
+            "ln2_s": jnp.ones((L, D), cfg.dtype),
+            "ln2_b": jnp.zeros((L, D), cfg.dtype),
+        },
+        "final_ln_s": jnp.ones((D,), cfg.dtype),
+        "final_ln_b": jnp.zeros((D,), cfg.dtype),
+        "cls": norm(ks[6], D, cfg.n_classes),
+    }
+
+
+def encode(params: Dict, tokens: jax.Array, cfg: BertConfig) -> jax.Array:
+    """tokens [B, T] → hidden [B, T, D] (bidirectional attention)."""
+    B, T = tokens.shape
+    x = (params["tok_embed"][tokens] + params["pos_embed"][:T]).astype(cfg.dtype)
+
+    def block(x, blk):
+        qkv = x @ blk["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, T, cfg.n_heads, cfg.head_dim)
+        attn = dense_attention(q.reshape(shape), k.reshape(shape),
+                               v.reshape(shape), causal=False)
+        x = layer_norm(x + attn.reshape(B, T, -1) @ blk["wo"],
+                       blk["ln1_s"], blk["ln1_b"])
+        h = jax.nn.gelu(x @ blk["w1"]) @ blk["w2"]
+        x = layer_norm(x + h, blk["ln2_s"], blk["ln2_b"])
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+    return layer_norm(x, params["final_ln_s"], params["final_ln_b"])
+
+
+def classify(params: Dict, tokens: jax.Array, cfg: BertConfig) -> jax.Array:
+    """[CLS]-pooled logits [B, n_classes] — the serving surface."""
+    hidden = encode(params, tokens, cfg)
+    return (hidden[:, 0] @ params["cls"]).astype(jnp.float32)
+
+
+def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
+    import os
+    import time
+
+    cfg = BertConfig.base()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    B, T = 32, 128
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab)
+    infer = jax.jit(lambda p, t: classify(p, t, cfg))
+    infer(params, tokens).block_until_ready()  # compile
+    slo = float(os.environ.get("SLO", "0") or 0)
+    while True:
+        t0 = time.perf_counter()
+        infer(params, tokens).block_until_ready()
+        qps = B / (time.perf_counter() - t0)
+        print(f"bert-base qps={qps:.1f} slo={slo} "
+              f"chips={os.environ.get('TPU_VISIBLE_CHIPS', '?')}", flush=True)
+        time.sleep(1)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -220,3 +220,86 @@ def make_train_step(cfg: LlamaConfig, mesh: Optional[Mesh], optimizer):
         in_shardings=(pshard, None, {"tokens": batch_shard, "targets": batch_shard}),
         donate_argnums=(0, 1),
     )
+
+
+def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
+    """Gang-pod entrypoint: derive the mesh from the env the scheduler
+    injected (TPU_WORKER_ID/TPU_WORKER_HOSTNAMES via the ConfigMap side
+    channel — gang.py post_bind) and train/serve on synthetic data."""
+    import argparse
+    import os
+    import time
+
+    import optax
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--serve", action="store_true")
+    args = parser.parse_args()
+
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    worker_id = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
+    if hostnames and len(hostnames.split(",")) > 1:
+        import jax as _jax
+
+        _jax.distributed.initialize(
+            coordinator_address=f"{hostnames.split(',')[0]}:8476",
+            num_processes=len(hostnames.split(",")),
+            process_id=worker_id,
+        )
+    n = len(jax.devices())
+    from ..parallel import MeshSpec, make_mesh
+
+    tp = min(4, n)
+    mesh = make_mesh(MeshSpec.for_devices(n, tp=tp)) if n > 1 else None
+
+    cfg = LlamaConfig.llama3_8b() if not args.serve else LlamaConfig(
+        vocab=32000, d_model=1024, n_layers=8, n_heads=16, n_kv_heads=16,
+        d_ff=4096, max_seq=2048, remat=False,
+    )
+    B, T = (8, 2048) if not args.serve else (1, 512)
+    if mesh is not None:
+        # Multi-process SPMD: host-local eager arrays cannot feed a jit
+        # whose in_shardings span a non-fully-addressable mesh — build
+        # params and data INSIDE jit with global out_shardings, so each
+        # process materializes only its shards.
+        pshard = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), param_specs(cfg),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        params = jax.jit(partial(init_params, cfg), out_shardings=pshard)(
+            jax.random.PRNGKey(0)
+        )
+        tok_shard = NamedSharding(mesh, P(("dp", "fsdp"), None))
+        tokens = jax.jit(
+            lambda k: jax.random.randint(k, (B, T), 0, cfg.vocab),
+            out_shardings=tok_shard,
+        )(jax.random.PRNGKey(1))
+    else:
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab)
+    slo = float(os.environ.get("SLO", "0") or 0)
+    if args.serve:
+        infer = jax.jit(lambda p, t: forward(p, t, cfg, mesh))
+        infer(params, tokens).block_until_ready()
+        while True:
+            t0 = time.perf_counter()
+            infer(params, tokens).block_until_ready()
+            print(f"llama serve qps={1 / (time.perf_counter() - t0):.2f} "
+                  f"slo={slo}", flush=True)
+            time.sleep(1)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+    opt = optax.adamw(3e-4)
+    # jit keeps the optimizer state's shards following the params' shards
+    # (eager zeros_like would be fine single-host; multi-host needs it).
+    state = jax.jit(opt.init)(params)
+    step = make_train_step(cfg, mesh, opt)
+    while True:
+        t0 = time.perf_counter()
+        params, state, loss = step(params, state, batch)
+        print(f"llama pretrain worker={worker_id} "
+              f"tok/s={B * T / (time.perf_counter() - t0):.0f} "
+              f"loss={float(loss):.3f}", flush=True)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,153 @@
+"""ResNet-50 — the single-chip training workload (BASELINE config 2).
+
+Pure-JAX bottleneck ResNet with a stacked/scanned layer scheme like the
+decoder: stages carry (conv weights, batch-norm scale/bias) pytrees and the
+forward is NHWC convolutions — the MXU-friendly layout on TPU (lax conv with
+feature-last avoids transposes). BatchNorm runs in inference-style
+normalization with learned scale/bias plus batch statistics during training
+(simple, jit-stable: no running-average state threading).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: tuple = (3, 4, 6, 3)  # ResNet-50
+    width: int = 64
+    n_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def resnet50() -> "ResNetConfig":
+        return ResNetConfig()
+
+    @staticmethod
+    def tiny() -> "ResNetConfig":
+        return ResNetConfig(stage_sizes=(1, 1), width=8, n_classes=10)
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _bn(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean((0, 1, 2), keepdims=True)
+    var = x32.var((0, 1, 2), keepdims=True)
+    return (((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale + bias)
+
+
+def init_params(cfg: ResNetConfig, key: jax.Array) -> Dict:
+    keys = iter(jax.random.split(key, 256))
+
+    def conv_w(k, kh, kw, cin, cout):
+        fan_in = kh * kw * cin
+        return (jax.random.normal(k, (kh, kw, cin, cout), jnp.float32)
+                * (2.0 / fan_in) ** 0.5).astype(cfg.dtype)
+
+    def bn_p(c):
+        return {"scale": jnp.ones((c,), cfg.dtype), "bias": jnp.zeros((c,), cfg.dtype)}
+
+    params: Dict = {
+        "stem": {"conv": conv_w(next(keys), 7, 7, 3, cfg.width), **bn_p(cfg.width)},
+        "stages": [],
+    }
+    cin = cfg.width
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        cmid = cfg.width * (2 ** s)
+        cout = cmid * 4
+        blocks: List[Dict] = []
+        for b in range(n_blocks):
+            blocks.append({
+                "c1": conv_w(next(keys), 1, 1, cin, cmid), "b1": bn_p(cmid),
+                "c2": conv_w(next(keys), 3, 3, cmid, cmid), "b2": bn_p(cmid),
+                "c3": conv_w(next(keys), 1, 1, cmid, cout), "b3": bn_p(cout),
+                "proj": (conv_w(next(keys), 1, 1, cin, cout)
+                         if (b == 0) else jnp.zeros((0,), cfg.dtype)),
+                "bproj": bn_p(cout) if b == 0 else {"scale": jnp.zeros((0,)),
+                                                    "bias": jnp.zeros((0,))},
+            })
+            cin = cout
+        params["stages"].append(blocks)
+    params["head"] = (jax.random.normal(next(keys), (cin, cfg.n_classes),
+                                        jnp.float32) * 0.01).astype(cfg.dtype)
+    return params
+
+
+def forward(params: Dict, images: jax.Array, cfg: ResNetConfig) -> jax.Array:
+    """images [B, H, W, 3] → logits [B, n_classes]."""
+    x = images.astype(cfg.dtype)
+    x = _bn(_conv(x, params["stem"]["conv"], stride=2),
+            params["stem"]["scale"], params["stem"]["bias"])
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+    for s, blocks in enumerate(params["stages"]):
+        for b, blk in enumerate(blocks):
+            stride = 2 if (s > 0 and b == 0) else 1
+            h = jax.nn.relu(_bn(_conv(x, blk["c1"]), **blk["b1"]))
+            h = jax.nn.relu(_bn(_conv(h, blk["c2"], stride=stride), **blk["b2"]))
+            h = _bn(_conv(h, blk["c3"]), **blk["b3"])
+            if blk["proj"].size:
+                x = _bn(_conv(x, blk["proj"], stride=stride), **blk["bproj"])
+            x = jax.nn.relu(x + h)
+    x = x.mean(axis=(1, 2))
+    return (x @ params["head"]).astype(jnp.float32)
+
+
+def loss_fn(params: Dict, batch: Dict, cfg: ResNetConfig) -> jax.Array:
+    logits = forward(params, batch["images"], cfg)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, batch["labels"][:, None], axis=1).mean()
+
+
+def make_train_step(cfg: ResNetConfig, optimizer):
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u.astype(p.dtype), params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step)
+
+
+def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
+    import os
+    import time
+
+    import optax
+
+    cfg = ResNetConfig.resnet50()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    B = 64
+    batch = {
+        "images": jax.random.normal(jax.random.PRNGKey(1), (B, 224, 224, 3)),
+        "labels": jax.random.randint(jax.random.PRNGKey(2), (B,), 0, cfg.n_classes),
+    }
+    opt = optax.sgd(0.1, momentum=0.9)
+    state = opt.init(params)
+    step = make_train_step(cfg, opt)
+    params, state, loss = step(params, state, batch)  # compile
+    float(loss)
+    slo = float(os.environ.get("SLO", "0") or 0)
+    while True:
+        t0 = time.perf_counter()
+        params, state, loss = step(params, state, batch)
+        float(loss)
+        ips = B / (time.perf_counter() - t0)
+        print(f"resnet50 img/s={ips:.1f} loss={float(loss):.3f} slo={slo} "
+              f"chips={os.environ.get('TPU_VISIBLE_CHIPS', '?')}", flush=True)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,332 @@
+"""KubeAPIServer adapter tests against a faithful fake kube-apiserver.
+
+The fake speaks enough of the real REST surface (all-namespace LIST,
+streaming WATCH with resourceVersion, POST create, merge-PATCH, the Binding
+subresource, DELETE) that the ENTIRE scheduler stack — informers, cache,
+TPU plugin, binding — runs unchanged over HTTP, which is the `--in-cluster`
+deployment mode of cmd/scheduler.py.
+"""
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from k8s_gpu_scheduler_tpu.cluster.kubeapi import KubeAPIServer
+from k8s_gpu_scheduler_tpu.cluster.apiserver import NotFound
+
+
+class FakeKube:
+    """In-memory k8s REST server. Store: kind -> {ns/name: json-dict}."""
+
+    def __init__(self):
+        self.store = {"pods": {}, "nodes": {}, "configmaps": {}, "podgroups": {}}
+        self.rv = 100
+        self.mu = threading.Lock()
+        self.watchers = []  # (plural, queue-like list, condition)
+        self.binding_posts = []
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            # -- helpers --------------------------------------------------
+            def _send(self, code, doc):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _route(self):
+                # /api/v1/<plural>, /api/v1/namespaces/<ns>/<plural>[/<name>[/binding]]
+                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                if parts[:2] == ["apis", "scheduling.tpu.dev"]:
+                    parts = parts[3:]  # strip apis/<group>/<version>
+                else:
+                    parts = parts[2:]  # strip api/v1
+                ns = name = sub = None
+                if parts and parts[0] == "namespaces":
+                    ns, parts = parts[1], parts[2:]
+                plural = parts[0]
+                if len(parts) > 1:
+                    name = parts[1]
+                if len(parts) > 2:
+                    sub = parts[2]
+                return plural, ns, name, sub
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            # -- verbs ----------------------------------------------------
+            def do_GET(self):
+                plural, ns, name, _ = self._route()
+                if name:
+                    with fake.mu:
+                        obj = fake._get(plural, ns, name)
+                    if obj is None:
+                        return self._send(404, {"reason": "NotFound"})
+                    return self._send(200, obj)
+                if "watch=1" in self.path:
+                    return self._watch(plural)
+                with fake.mu:
+                    items = [o for k, o in sorted(fake.store[plural].items())]
+                    rv = str(fake.rv)
+                return self._send(200, {
+                    "kind": "List", "metadata": {"resourceVersion": rv},
+                    "items": items,
+                })
+
+            def _watch(self, plural):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                cond = threading.Condition()
+                events = []
+                with fake.mu:
+                    fake.watchers.append((plural, events, cond))
+                try:
+                    while True:
+                        with cond:
+                            while not events:
+                                if not cond.wait(timeout=10):
+                                    return
+                            ev = events.pop(0)
+                        line = json.dumps(ev).encode() + b"\n"
+                        self.wfile.write(f"{len(line):x}\r\n".encode()
+                                         + line + b"\r\n")
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    return
+
+            def do_POST(self):
+                plural, ns, name, sub = self._route()
+                body = self._body()
+                if sub == "binding":
+                    node = body["target"]["name"]
+                    with fake.mu:
+                        obj = fake._get(plural, ns, name)
+                        if obj is None:
+                            return self._send(404, {})
+                        obj["spec"]["nodeName"] = node
+                        fake._bump(obj)
+                        fake.binding_posts.append((ns, name, node))
+                        fake._emit(plural, "MODIFIED", obj)
+                    return self._send(201, {"kind": "Status", "status": "Success"})
+                with fake.mu:
+                    meta = body.setdefault("metadata", {})
+                    meta.setdefault("namespace", ns or "default")
+                    key = f"{meta['namespace']}/{meta['name']}"
+                    if key in fake.store[plural]:
+                        return self._send(409, {"reason": "AlreadyExists"})
+                    meta.setdefault("uid", f"uid-{meta['name']}")
+                    body.setdefault("spec", {})
+                    body.setdefault("status", {"phase": "Pending"}
+                                    if plural == "pods" else {})
+                    fake._bump(body)
+                    fake.store[plural][key] = body
+                    fake._emit(plural, "ADDED", body)
+                return self._send(201, body)
+
+            def do_PATCH(self):
+                plural, ns, name, _ = self._route()
+                patch = self._body()
+                with fake.mu:
+                    obj = fake._get(plural, ns, name)
+                    if obj is None:
+                        return self._send(404, {})
+                    fake._merge(obj, patch)
+                    fake._bump(obj)
+                    fake._emit(plural, "MODIFIED", obj)
+                return self._send(200, obj)
+
+            def do_DELETE(self):
+                plural, ns, name, _ = self._route()
+                with fake.mu:
+                    obj = fake._get(plural, ns, name)
+                    if obj is None:
+                        return self._send(404, {})
+                    key = f"{obj['metadata'].get('namespace', 'default')}/{name}"
+                    if plural == "nodes":
+                        key = f"default/{name}"
+                    fake.store[plural].pop(key, None)
+                    fake._emit(plural, "DELETED", obj)
+                return self._send(200, {"kind": "Status", "status": "Success"})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.server.server_port}"
+
+    def _get(self, plural, ns, name):
+        key = f"{ns or 'default'}/{name}"
+        return self.store[plural].get(key)
+
+    def _bump(self, obj):
+        self.rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+
+    def _merge(self, base, patch):
+        """RFC 7386 merge patch: dicts merge recursively, None deletes."""
+        for k, v in patch.items():
+            if v is None:
+                base.pop(k, None)
+            elif isinstance(v, dict) and isinstance(base.get(k), dict):
+                self._merge(base[k], v)
+            else:
+                base[k] = v
+
+    def _emit(self, plural, ev_type, obj):
+        for wplural, events, cond in self.watchers:
+            if wplural == plural:
+                with cond:
+                    events.append({"type": ev_type,
+                                   "object": json.loads(json.dumps(obj))})
+                    cond.notify_all()
+
+    def add_node(self, name, chips=8, labels=None):
+        lab = {"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+               "cloud.google.com/gke-tpu-topology": "2x4"}
+        lab.update(labels or {})
+        with self.mu:
+            obj = {
+                "kind": "Node",
+                "metadata": {"name": name, "labels": lab, "annotations": {},
+                             "uid": f"uid-{name}"},
+                "status": {
+                    "capacity": {"google.com/tpu": str(chips)},
+                    "allocatable": {"google.com/tpu": str(chips)},
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                    "addresses": [{"type": "InternalIP",
+                                   "address": "10.0.0.1"}],
+                },
+            }
+            self._bump(obj)
+            self.store["nodes"][f"default/{name}"] = obj
+            self._emit("nodes", "ADDED", obj)
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def fake():
+    f = FakeKube()
+    yield f
+    f.close()
+
+
+class TestAdapter:
+    def test_create_get_list_roundtrip(self, fake):
+        from tests.test_plugins import mk_pod
+
+        api = KubeAPIServer(base_url=fake.url)
+        api.create(mk_pod("p1", chips=2, slo=10.0, cm="cm-a"))
+        pod = api.get("Pod", "p1", "default")
+        assert pod.spec.tpu_chips() == 2
+        assert pod.get_env("SLO") == "10.0"
+        assert pod.spec.containers[0].env_from[0].name == "cm-a"
+        assert [p.metadata.name for p in api.list("Pod")] == ["p1"]
+
+    def test_node_mapping(self, fake):
+        fake.add_node("n1", chips=4)
+        api = KubeAPIServer(base_url=fake.url)
+        node = api.get("Node", "n1")
+        assert node.tpu_capacity() == 4
+        assert node.tpu_topology() == "2x4"
+        assert "Ready" in node.status.conditions
+
+    def test_mutate_patches_configmap(self, fake):
+        from k8s_gpu_scheduler_tpu.api.objects import ConfigMap, ObjectMeta
+
+        api = KubeAPIServer(base_url=fake.url)
+        api.create(ConfigMap(metadata=ObjectMeta(name="cm"), data={"a": "1"}))
+
+        def fn(cm):
+            cm.data["b"] = "2"
+
+        api.mutate("ConfigMap", "cm", "default", fn)
+        assert api.get("ConfigMap", "cm").data == {"a": "1", "b": "2"}
+
+    def test_bind_uses_binding_subresource(self, fake):
+        from tests.test_plugins import mk_pod
+
+        fake.add_node("n1")
+        api = KubeAPIServer(base_url=fake.url)
+        api.create(mk_pod("p1"))
+
+        def fn(p):
+            p.spec.node_name = "n1"
+
+        api.mutate("Pod", "p1", "default", fn)
+        assert fake.binding_posts == [("default", "p1", "n1")]
+
+    def test_missing_object_raises_notfound(self, fake):
+        api = KubeAPIServer(base_url=fake.url)
+        with pytest.raises(NotFound):
+            api.get("Pod", "nope", "default")
+
+    def test_watch_streams_events(self, fake):
+        from tests.test_plugins import mk_pod
+
+        api = KubeAPIServer(base_url=fake.url)
+        w = api.watch("Pod", send_initial=True)
+        api.create(mk_pod("p1"))
+        ev = w.next(timeout=5)
+        assert ev is not None and ev.type == "ADDED"
+        assert ev.obj.metadata.name == "p1"
+        w.stop()
+        assert w.next(timeout=1) is None
+
+
+class TestSchedulerOverREST:
+    def test_full_cycle_binds_and_injects(self, fake):
+        """The unchanged Scheduler + TPU plugin stack schedules through the
+        REST adapter: watch-fed informers, Score, Binding subresource,
+        PostBind ConfigMap injection."""
+        from k8s_gpu_scheduler_tpu.api.objects import ConfigMap, ObjectMeta
+        from k8s_gpu_scheduler_tpu.config import SchedulerConfig
+        from k8s_gpu_scheduler_tpu.plugins import TPUPlugin
+        from k8s_gpu_scheduler_tpu.sched import Profile, Scheduler
+        from tests.test_plugins import FakeRegistry, mk_pod, wait_until
+
+        fake.add_node("n1")
+        fake.add_node("n2")
+        api = KubeAPIServer(base_url=fake.url)
+        reg = FakeRegistry()
+        reg.publish("n1", utilization=0.8)
+        reg.publish("n2", utilization=0.1)
+        cfg = SchedulerConfig(backoff_initial_s=0.05, backoff_max_s=0.2)
+        sched = Scheduler(api, profile=Profile(), config=cfg)
+        tpu = TPUPlugin(sched.handle, registry=reg)
+        sched.profile = Profile(pre_filter=[tpu], filter=[tpu], score=[tpu],
+                                reserve=[tpu], post_bind=[tpu])
+        api.create(ConfigMap(metadata=ObjectMeta(name="cm-p"), data={}))
+        api.create(mk_pod("p1", chips=8, cm="cm-p"))
+        sched.start()
+        try:
+            assert wait_until(
+                lambda: api.get("Pod", "p1", "default").spec.node_name,
+                timeout=10,
+            )
+            assert api.get("Pod", "p1", "default").spec.node_name == "n2"
+            assert fake.binding_posts == [("default", "p1", "n2")]
+            assert wait_until(
+                lambda: "TPU_VISIBLE_CHIPS"
+                in api.get("ConfigMap", "cm-p").data,
+                timeout=5,
+            )
+        finally:
+            sched.stop()

@@ -67,3 +67,58 @@ class TestLlama:
         # Llama-3-8B ≈ 8e9 params → ~4.8e10 train FLOPs/token.
         f = LlamaConfig.llama3_8b().flops_per_token()
         assert 3e10 < f < 7e10
+
+
+class TestBert:
+    def test_classify_shape_and_bidirectional(self):
+        from k8s_gpu_scheduler_tpu.models.bert import (
+            BertConfig, classify, encode, init_params,
+        )
+
+        cfg = BertConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab)
+        logits = classify(params, tokens, cfg)
+        assert logits.shape == (2, cfg.n_classes)
+        # Bidirectionality: changing the LAST token must change the FIRST
+        # position's hidden state (causal attention would not).
+        h1 = encode(params, tokens, cfg)
+        tokens2 = tokens.at[:, -1].set((tokens[:, -1] + 1) % cfg.vocab)
+        h2 = encode(params, tokens2, cfg)
+        assert float(jnp.abs(h1[:, 0] - h2[:, 0]).max()) > 0
+
+
+class TestResNet:
+    def test_forward_shape(self):
+        from k8s_gpu_scheduler_tpu.models.resnet import (
+            ResNetConfig, forward, init_params,
+        )
+
+        cfg = ResNetConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        images = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        logits = forward(params, images, cfg)
+        assert logits.shape == (2, cfg.n_classes)
+
+    def test_train_step_decreases_loss(self):
+        import optax
+
+        from k8s_gpu_scheduler_tpu.models.resnet import (
+            ResNetConfig, init_params, make_train_step,
+        )
+
+        cfg = ResNetConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        batch = {
+            "images": jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3)),
+            "labels": jax.random.randint(jax.random.PRNGKey(2), (8,), 0,
+                                         cfg.n_classes),
+        }
+        opt = optax.sgd(0.05, momentum=0.9)
+        state = opt.init(params)
+        step = make_train_step(cfg, opt)
+        first = None
+        for _ in range(6):
+            params, state, loss = step(params, state, batch)
+            first = first if first is not None else float(loss)
+        assert float(loss) < first
