@@ -38,7 +38,7 @@ class LlamaConfig:
     max_seq: int = 8192
     rope_theta: float = 500000.0
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "dense"  # dense | ring | ulysses
+    attn_impl: str = "dense"  # dense | ring | ulysses | flash (pallas)
     remat: bool = True
 
     @property
@@ -136,6 +136,10 @@ def _attention(cfg: LlamaConfig, mesh: Optional[Mesh], q, k, v):
     """Dispatch dense vs sequence-parallel attention. q/k/v are GLOBAL
     [B, T, H(kv), hd]; the shard_map island re-chunks T over 'sp' and heads
     over 'tp' and runs the ring/all_to_all collectives inside."""
+    if cfg.attn_impl == "flash":
+        from ..ops.flash_attention import flash_attention_diff
+
+        return flash_attention_diff(q, k, v, True)
     if cfg.attn_impl == "dense" or mesh is None or "sp" not in mesh.axis_names:
         return dense_attention(q, k, v, causal=True)
     if mesh.shape["sp"] == 1:

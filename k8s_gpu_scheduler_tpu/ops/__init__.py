@@ -8,6 +8,7 @@ rebuild to treat as first-class.
 """
 from .layers import apply_rope, rms_norm, rope_freqs, swiglu
 from .attention import dense_attention, ring_attention, ulysses_attention
+from .flash_attention import flash_attention, flash_attention_diff
 
 __all__ = [
     "rms_norm",
@@ -17,4 +18,6 @@ __all__ = [
     "dense_attention",
     "ring_attention",
     "ulysses_attention",
+    "flash_attention",
+    "flash_attention_diff",
 ]

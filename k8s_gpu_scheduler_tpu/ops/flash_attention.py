@@ -1,0 +1,168 @@
+"""Flash attention — a Pallas TPU kernel for the serving hot path.
+
+Dense attention materializes the [T, T] score matrix in HBM; this kernel
+streams K/V blocks through VMEM keeping flash-style running softmax stats
+(m, l) in scratch, so memory is O(block² ) and the MXU sees back-to-back
+[block_q, d]×[d, block_k] and [block_q, block_k]×[block_k, d] matmuls.
+
+Grid = (batch·heads, q_blocks, kv_blocks), kv innermost and sequential
+("arbitrary" semantics): scratch accumulators persist across the kv sweep,
+reset at kv==0, normalized+written at the last kv block. Fully-masked
+causal blocks are skipped with pl.when (≈2× fewer FLOPs at long T).
+
+Forward-only: the training path keeps dense/ring attention (those
+differentiate through XLA); flash serves inference (models.llama --serve,
+BASELINE config 5) where the backward pass never runs. On CPU the wrapper
+transparently uses interpret mode, so tests run hermetically.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANES = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    last_k = pl.num_programs(2) - 1
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: the whole block is masked when its lowest k position exceeds
+    # the highest q position — skip the matmuls entirely.
+    diag_reachable = (ki * block_k) <= (qi * block_q + block_q - 1)
+    should_compute = diag_reachable if causal else True
+
+    @pl.when(should_compute)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)           # [bq, d]
+        k = k_ref[0].astype(jnp.float32)           # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                   # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                       # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)   # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                      # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)             # [bq, 1]
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == last_k)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Drop-in for dense_attention: q [B, T, H, d], k/v [B, T, Hkv, d] →
+    [B, T, H, d]. T must divide by the block sizes (pad upstream or use
+    dense for ragged tails). GQA kv heads are repeated to H."""
+    b, t, n_heads, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"seq len {t} not divisible by blocks "
+                         f"({block_q}/{block_k})")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    h_kv = k.shape[2]
+    if h_kv != n_heads:
+        k = jnp.repeat(k, n_heads // h_kv, axis=2)
+        v = jnp.repeat(v, n_heads // h_kv, axis=2)
+
+    # [B, T, H, d] → [B·H, T, d]
+    def bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * n_heads, t, d)
+
+    q3, k3, v3 = bh(q), bh(k), bh(v)
+    grid = (b * n_heads, t // block_q, t // block_k)
+    kernel = functools.partial(
+        _flash_kernel, scale=1.0 / math.sqrt(d), causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * n_heads, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # m
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # l
+            pltpu.VMEM((block_q, d), jnp.float32),       # acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(b, n_heads, t, d).transpose(0, 2, 1, 3)
+
+
+# -- differentiable wrapper ---------------------------------------------------
+#
+# Pallas kernels don't autodiff; training with attn_impl="flash" gets the
+# flash FORWARD (O(block²) memory, the long-context win is in activations
+# saved for remat) and a recompute-through-dense BACKWARD (exact gradients,
+# dense-cost bwd). Serving uses flash_attention directly.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention_diff(q, k, v, causal: bool = True):
+    return flash_attention(q, k, v, causal=causal)
+
+
+def _fwd(q, k, v, causal):
+    return flash_attention(q, k, v, causal=causal), (q, k, v)
+
+
+def _bwd(causal, res, g):
+    from .attention import dense_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: dense_attention(q, k, v, causal=causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention_diff.defvjp(_fwd, _bwd)
