@@ -122,9 +122,9 @@ def bench_train_mfu():
         # ~134M params is plenty to saturate the MXU for an MFU readout.
         cfg = LlamaConfig(
             vocab=32000, d_model=1024, n_layers=6, n_heads=16, n_kv_heads=16,
-            d_ff=4096, max_seq=1024, remat=False,
+            d_ff=4096, max_seq=1024, remat=False, attn_impl="flash",
         )
-        B, T, steps = 8, 1024, 5
+        B, T, steps = 8, 1024, 20
     else:
         cfg = LlamaConfig(
             vocab=1024, d_model=128, n_layers=2, n_heads=8, n_kv_heads=8,
@@ -144,10 +144,13 @@ def bench_train_mfu():
     t0 = time.perf_counter()
     for _ in range(steps):
         params, state, loss = step(params, state, batch)
-        # Full sync EVERY step: under the axon tunnel, blocking only on the
-        # final loss returns before the chained device work finishes and
-        # reads ~2000x too fast.
-        float(loss)
+    # ONE host sync at the end. float() (unlike block_until_ready, which the
+    # axon tunnel resolves early) cannot return until the value exists, and
+    # the value of step N's loss data-depends on steps 1..N-1 through the
+    # donated params — so this bounds all device work. Syncing every step
+    # (round-2 bench) charged the ~96 ms tunnel round-trip latency to every
+    # step and under-read throughput ~2×.
+    float(loss)
     dt = (time.perf_counter() - t0) / steps
 
     tokens_per_s = B * T / dt

@@ -136,15 +136,31 @@ def _attention(cfg: LlamaConfig, mesh: Optional[Mesh], q, k, v):
     """Dispatch dense vs sequence-parallel attention. q/k/v are GLOBAL
     [B, T, H(kv), hd]; the shard_map island re-chunks T over 'sp' and heads
     over 'tp' and runs the ring/all_to_all collectives inside."""
+    seq_parallel = (mesh is not None and "sp" in mesh.axis_names
+                    and mesh.shape["sp"] > 1)
     if cfg.attn_impl == "flash":
         from ..ops.flash_attention import flash_attention_diff
 
-        return flash_attention_diff(q, k, v, True)
-    if cfg.attn_impl == "dense" or mesh is None or "sp" not in mesh.axis_names:
+        if mesh is None or mesh.size == 1:
+            return flash_attention_diff(q, k, v, True)
+        if not seq_parallel:
+            # Pallas calls don't partition under GSPMD (XLA would replicate
+            # the operands), so shard batch/head dims explicitly and run the
+            # kernel per shard — attention is embarrassingly parallel over
+            # (dp·fsdp, tp) when the sequence axis is whole.
+            spec = P(("dp", "fsdp"), None, "tp", None)
+            fn = jax.shard_map(
+                lambda q, k, v: flash_attention_diff(q, k, v, True),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False,
+            )
+            return fn(q, k, v)
+        # sp > 1: the sequence-parallel ring (flash-style running stats,
+        # XLA collectives over ICI) is the equivalent-cost path.
+    if cfg.attn_impl == "dense" or not seq_parallel:
         return dense_attention(q, k, v, causal=True)
-    if mesh.shape["sp"] == 1:
-        return dense_attention(q, k, v, causal=True)
-    impl = ring_attention if cfg.attn_impl == "ring" else ulysses_attention
+    impl = (ulysses_attention if cfg.attn_impl == "ulysses"
+            else ring_attention)
     spec = P(("dp", "fsdp"), "sp", "tp", None)
     fn = jax.shard_map(
         partial(impl, axis_name="sp", causal=True),
@@ -211,7 +227,9 @@ def make_train_step(cfg: LlamaConfig, mesh: Optional[Mesh], optimizer):
         return params, opt_state, loss
 
     if mesh is None:
-        return jax.jit(step)
+        # Donation matters single-device too: without it every step keeps a
+        # second copy of params+opt state live in HBM.
+        return jax.jit(step, donate_argnums=(0, 1))
 
     pspecs = param_specs(cfg)
     pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,

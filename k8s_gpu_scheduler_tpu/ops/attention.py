@@ -33,6 +33,9 @@ def _repeat_kv(k: jax.Array, n_heads: int) -> jax.Array:
     h_kv = k.shape[2]
     if h_kv == n_heads:
         return k
+    if n_heads % h_kv:
+        raise ValueError(
+            f"GQA needs n_heads ({n_heads}) divisible by kv heads ({h_kv})")
     return jnp.repeat(k, n_heads // h_kv, axis=2)
 
 

@@ -165,3 +165,77 @@ class TestFlashAttention:
         g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
         for gf, gd in zip(g_flash, g_dense):
             assert jnp.abs(gf - gd).max() < 2e-5
+
+    def test_gradients_multi_block_weighted(self):
+        """T=512 with 128-blocks: the bwd dq kv-sweep and dkv q-sweep both
+        cross 4 blocks; a non-uniform cotangent catches p/ds mixups that a
+        .sum() loss cancels out."""
+        from k8s_gpu_scheduler_tpu.ops import flash_attention_diff
+
+        q, k, v = qkv(T=512, H=2, Hkv=2, d=32)
+        w = jax.random.normal(jax.random.PRNGKey(7), (2, 512, 2, 32))
+
+        def loss(impl):
+            def f(q, k, v):
+                return (impl(q, k, v) * w).sum()
+            return f
+
+        g_flash = jax.grad(
+            loss(lambda q, k, v: flash_attention_diff(q, k, v, True, 128, 128)),
+            argnums=(0, 1, 2))(q, k, v)
+        g_dense = jax.grad(
+            loss(lambda q, k, v: dense_attention(q, k, v, causal=True)),
+            argnums=(0, 1, 2))(q, k, v)
+        for gf, gd in zip(g_flash, g_dense):
+            assert jnp.abs(gf - gd).max() < 3e-4
+
+    def test_gradients_gqa_and_noncausal(self):
+        """GQA: dk/dv must sum over the repeated head groups; also checks
+        the non-causal backward (no block skipping)."""
+        from k8s_gpu_scheduler_tpu.ops import flash_attention_diff
+
+        q, k, v = qkv(T=128, H=4, Hkv=2, d=32)
+        w = jax.random.normal(jax.random.PRNGKey(3), (2, 128, 4, 32))
+        for causal in (True, False):
+            g_flash = jax.grad(
+                lambda q, k, v: (flash_attention_diff(q, k, v, causal) * w).sum(),
+                argnums=(0, 1, 2))(q, k, v)
+            g_dense = jax.grad(
+                lambda q, k, v: (dense_attention(q, k, v, causal=causal) * w).sum(),
+                argnums=(0, 1, 2))(q, k, v)
+            for gf, gd in zip(g_flash, g_dense):
+                assert gf.shape == gd.shape
+                assert jnp.abs(gf - gd).max() < 3e-4
+
+    def test_flash_shard_map_dp_tp(self):
+        """The model's non-sp mesh path: flash under shard_map sharded over
+        (batch, heads) must match dense on the global arrays — fwd and bwd."""
+        from k8s_gpu_scheduler_tpu.ops import flash_attention_diff
+
+        mesh = make_mesh(MeshSpec({"dp": 2, "fsdp": 1, "sp": 1, "tp": 4}))
+        q, k, v = qkv(B=2, T=128, H=8, Hkv=4, d=32)
+        spec = P(("dp", "fsdp"), None, "tp", None)
+        fn = jax.jit(jax.shard_map(
+            lambda q, k, v: flash_attention_diff(q, k, v, True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        ))
+        ref = dense_attention(q, k, v, causal=True)
+        assert jnp.abs(fn(q, k, v) - ref).max() < 2e-5
+        g_flash = jax.grad(lambda q, k, v: fn(q, k, v).sum(),
+                           argnums=(0, 1, 2))(q, k, v)
+        g_dense = jax.grad(
+            lambda q, k, v: dense_attention(q, k, v, causal=True).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for gf, gd in zip(g_flash, g_dense):
+            assert jnp.abs(gf - gd).max() < 3e-4
+
+    def test_gqa_head_divisibility_rejected(self):
+        from k8s_gpu_scheduler_tpu.ops import flash_attention
+
+        q, _, _ = qkv(T=128, H=6, Hkv=6, d=32)
+        _, k, v = qkv(T=128, H=6, Hkv=4, d=32)
+        with pytest.raises(ValueError):
+            flash_attention(q, k, v, interpret=True)
+        with pytest.raises(ValueError):
+            dense_attention(q, k, v)
