@@ -9,6 +9,10 @@ parallel/ — the flagship (llama) is what __graft_entry__/bench.py drive.
 from .llama import LlamaConfig, init_params, forward, loss_fn, make_train_step
 from .bert import BertConfig
 from .resnet import ResNetConfig
+from .serving import (
+    cached_attention, forward_with_cache, generate, init_cache,
+    make_server_step,
+)
 
 __all__ = [
     "LlamaConfig",
@@ -18,4 +22,9 @@ __all__ = [
     "forward",
     "loss_fn",
     "make_train_step",
+    "cached_attention",
+    "forward_with_cache",
+    "generate",
+    "init_cache",
+    "make_server_step",
 ]

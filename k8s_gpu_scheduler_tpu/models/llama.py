@@ -178,7 +178,16 @@ def forward(
     """tokens [B, T] int32 → logits [B, T, vocab]."""
     B, T = tokens.shape
     angles = rope_freqs(cfg.head_dim, T, cfg.rope_theta)
-    x = params["embed"][tokens].astype(cfg.dtype)
+    # FSDP-style lookup: all-gather the table explicitly, then gather with
+    # (batch, seq)-sharded indices — each device reads only its rows. Left
+    # implicit, GSPMD operand-passthroughs the table sharding onto the
+    # activation and can only reach the activation sharding by full
+    # rematerialization (the round-2 SPMD warnings in MULTICHIP_r02.json).
+    # The transpose is a reduce-scatter back into the sharded table grad —
+    # the same collective pair FSDP pays for every weight.
+    tokens = _constrain(tokens, mesh, P(("dp", "fsdp"), "sp"))
+    table = _constrain(params["embed"], mesh, P(None, None))
+    x = table[tokens].astype(cfg.dtype)
     x = _constrain(x, mesh, P(("dp", "fsdp"), "sp", None))
 
     def block(x, blk):
@@ -256,6 +265,8 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--serve", action="store_true")
+    parser.add_argument("--prompt-len", type=int, default=512)
+    parser.add_argument("--max-new", type=int, default=64)
     args = parser.parse_args()
 
     hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
@@ -278,7 +289,7 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
         vocab=32000, d_model=1024, n_layers=8, n_heads=16, n_kv_heads=16,
         d_ff=4096, max_seq=2048, remat=False,
     )
-    B, T = (8, 2048) if not args.serve else (1, 512)
+    B, T = (8, 2048) if not args.serve else (1, args.prompt_len)
     if mesh is not None:
         # Multi-process SPMD: host-local eager arrays cannot feed a jit
         # whose in_shardings span a non-fully-addressable mesh — build
@@ -301,14 +312,26 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
         tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab)
     slo = float(os.environ.get("SLO", "0") or 0)
     if args.serve:
-        infer = jax.jit(lambda p, t: forward(p, t, cfg, mesh))
-        infer(params, tokens).block_until_ready()
+        # Real serving: prefill + KV-cache greedy decode (serving.py), one
+        # jitted program per request shape. QPS is per decoded REQUEST;
+        # decode tok/s is the per-token rate the recommender right-sizes
+        # against (BASELINE config 5).
+        from .serving import make_server_step
+
+        Tp, max_new = args.prompt_len, args.max_new
+        handler = make_server_step(cfg, mesh, max_new, max_len=cfg.max_seq)
+        prompt = tokens[:, :Tp]
+        handler(params, prompt).block_until_ready()  # compile
         while True:
             t0 = time.perf_counter()
-            infer(params, tokens).block_until_ready()
-            print(f"llama serve qps={1 / (time.perf_counter() - t0):.2f} "
-                  f"slo={slo}", flush=True)
-            time.sleep(1)
+            out = handler(params, prompt)
+            int(out[0, -1])  # host sync on the full decode
+            dt = time.perf_counter() - t0
+            b = prompt.shape[0]
+            print(f"llama serve qps={b / dt:.2f} "
+                  f"decode_tok_s={b * max_new / dt:.1f} "
+                  f"prefill_tok={b * Tp} slo={slo}", flush=True)
+            time.sleep(max(0.0, 1.0 - dt))
     batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
     opt = optax.adamw(3e-4)
     # jit keeps the optimizer state's shards following the params' shards

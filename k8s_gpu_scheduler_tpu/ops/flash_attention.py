@@ -49,9 +49,14 @@ _LANES = 128
 
 # -- forward ------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
-                      acc_ref, *, scale: float, causal: bool, block_q: int,
-                      block_k: int):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
+                      causal: bool, block_q: int, block_k: int,
+                      with_lse: bool):
+    # Outputs/scratch after o_ref: [lse_ref,] m_ref, l_ref, acc_ref. The lse
+    # output exists only on the training path (with_lse) — forward-only
+    # callers (serving) skip its HBM write entirely.
+    lse_ref = rest[0] if with_lse else None
+    m_ref, l_ref, acc_ref = rest[-3:]
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     last_k = pl.num_programs(2) - 1
@@ -101,35 +106,40 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     def _finalize():
         l = l_ref[:, :1]
         o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
-        # lse rows broadcast across the 128 lanes (m/l scratch already are),
-        # sidestepping a sublane→lane transpose the Mosaic compiler dislikes.
-        lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-20))
+        if with_lse:
+            # lse rows broadcast across the 128 lanes (m/l scratch already
+            # are), sidestepping a sublane→lane transpose the Mosaic
+            # compiler dislikes.
+            lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-20))
 
 
-def _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret):
-    """[B·H, T, d] inputs → (out [B·H, T, d], lse [B·H, T, 128] f32)."""
+def _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret,
+                   with_lse=True):
+    """[B·H, T, d] inputs → (out [B·H, T, d], lse [B·H, T, 128] f32 or
+    None when with_lse=False — the forward-only path skips the write)."""
     bh, t, d = q3.shape
     grid = (bh, t // block_q, t // block_k)
     kernel = functools.partial(
         _flash_fwd_kernel, scale=1.0 / math.sqrt(d), causal=causal,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, with_lse=with_lse,
     )
-    return pl.pallas_call(
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0))
+    out_specs = [q_spec]
+    out_shape = [jax.ShapeDtypeStruct((bh, t, d), q3.dtype)]
+    if with_lse:
+        out_specs.append(
+            pl.BlockSpec((1, block_q, _LANES), lambda b, qi, ki: (b, qi, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, t, _LANES), jnp.float32))
+    out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            q_spec,
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, qi, ki: (b, qi, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, t, _LANES), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # m
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # l
@@ -140,6 +150,7 @@ def _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret):
         ),
         interpret=interpret,
     )(q3, k3, v3)
+    return (out[0], out[1]) if with_lse else (out[0], None)
 
 
 # -- backward -----------------------------------------------------------------
@@ -356,7 +367,7 @@ def flash_attention(
     k = _repeat_kv(k, n_heads)
     v = _repeat_kv(v, n_heads)
     out, _ = _flash_forward(_bh(q), _bh(k), _bh(v), causal, block_q, block_k,
-                            interpret)
+                            interpret, with_lse=False)
     return _unbh(out, b, n_heads)
 
 
