@@ -26,7 +26,12 @@ BASELINE_P50_MS = 100.0
 PEAK_TFLOPS = {"v5 lite": 197.0, "v5p": 459.0, "v4": 275.0, "v6": 918.0}
 
 
-def bench_schedule_churn(n_nodes=16, n_pods=64):
+def bench_schedule_churn(n_nodes=16, n_pods=64, rest=False):
+    """64-pod churn through the full plugin pipeline. ``rest=False`` drives
+    the in-memory APIServer (pure framework overhead); ``rest=True`` drives
+    the SAME stack through the Kubernetes REST adapter against a local fake
+    HTTP apiserver — every list/watch/bind is a real HTTP round trip, the
+    number comparable to a kube-scheduler p50 that includes the apiserver."""
     from k8s_gpu_scheduler_tpu.api.objects import (
         ConfigMap, ConfigMapRef, Container, LABEL_TPU_ACCELERATOR,
         LABEL_TPU_TOPOLOGY, Node, NodeStatus, ObjectMeta, Pod, PodSpec,
@@ -48,18 +53,29 @@ def bench_schedule_churn(n_nodes=16, n_pods=64):
         def get_keys(self, pattern="*"):
             return [k for k in self.data if k.startswith(pattern.rstrip("*"))]
 
-    server = APIServer()
+    fake = None
+    if rest:
+        from k8s_gpu_scheduler_tpu.cluster.kubeapi import KubeAPIServer
+        from tests.test_kubeapi import FakeKube
+
+        fake = FakeKube()
+        server = KubeAPIServer(base_url=fake.url)
+    else:
+        server = APIServer()
     reg = MemRegistry()
     for i in range(n_nodes):
         name = f"v5e-{i}"
-        server.create(Node(
-            metadata=ObjectMeta(name=name, labels={
-                LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
-                LABEL_TPU_TOPOLOGY: "2x4",
-            }),
-            status=NodeStatus(capacity={TPU_RESOURCE: 8},
-                              allocatable={TPU_RESOURCE: 8}),
-        ))
+        if rest:
+            fake.add_node(name, chips=8)
+        else:
+            server.create(Node(
+                metadata=ObjectMeta(name=name, labels={
+                    LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                    LABEL_TPU_TOPOLOGY: "2x4",
+                }),
+                status=NodeStatus(capacity={TPU_RESOURCE: 8},
+                                  allocatable={TPU_RESOURCE: 8}),
+            ))
         reg.data[node_key(name)] = NodeInventory(
             node_name=name, utilization=(i % 10) / 10.0
         ).to_json()
@@ -99,13 +115,16 @@ def bench_schedule_churn(n_nodes=16, n_pods=64):
         p50 = hist.quantile(0.5) or 0.0
         p99 = hist.quantile(0.99) or 0.0
         assert bound == n_pods, f"only {bound}/{n_pods} bound"
+        suffix = "_rest" if rest else ""
         return {
-            "p50_ms": round(p50 * 1000, 3),
-            "p99_ms": round(p99 * 1000, 3),
-            "pods_per_s": round(n_pods / wall, 1),
+            f"p50{suffix}_ms": round(p50 * 1000, 3),
+            f"p99{suffix}_ms": round(p99 * 1000, 3),
+            f"pods_per_s{suffix}": round(n_pods / wall, 1),
         }
     finally:
         sched.stop()
+        if fake is not None:
+            fake.close()
 
 
 def bench_train_mfu():
@@ -173,6 +192,10 @@ def bench_train_mfu():
 def main():
     churn = bench_schedule_churn()
     try:
+        churn_rest = bench_schedule_churn(rest=True)
+    except Exception as e:  # noqa: BLE001 — REST leg must not kill the line
+        churn_rest = {"rest_error": str(e)[:200]}
+    try:
         train = bench_train_mfu()
     except Exception as e:  # noqa: BLE001 — accelerator part must not kill the line
         train = {"error": str(e)[:200]}
@@ -182,7 +205,7 @@ def main():
         "value": churn["p50_ms"],
         "unit": "ms",
         "vs_baseline": round(BASELINE_P50_MS / p50, 2),
-        "extra": {**churn, **train},
+        "extra": {**churn, **churn_rest, **train},
     }))
 
 

@@ -53,6 +53,10 @@ class ObjectMeta:
     uid: str = field(default_factory=lambda: str(_uuid.uuid4()))
     resource_version: int = 0
     creation_timestamp: float = field(default_factory=_now)
+    # "kind/name" of each ownerReference controller (StatefulSet/Job/...).
+    # Empty = bare pod: deleting it is permanent, so preemption and gang
+    # collapse must never evict it (no controller will recreate it).
+    owner_references: List[str] = field(default_factory=list)
 
     @property
     def key(self) -> str:
@@ -188,7 +192,32 @@ class PodGroup:
     kind = "PodGroup"
 
 
-_KINDS = {"Pod": Pod, "Node": Node, "ConfigMap": ConfigMap, "PodGroup": PodGroup}
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease — leader election for scheduler HA.
+
+    The reference gets leader election from upstream kube-scheduler config
+    (/root/reference/deploy/scheduler.yaml:10-13 ``leaderElection:
+    leaderElect: true``); we own the framework, so the Lease object and the
+    elector (sched/leaderelection.py) live here. Times are epoch seconds
+    (converted to RFC3339 MicroTime at the REST boundary)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""
+    lease_duration_s: float = 15.0
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+
+    kind = "Lease"
+
+    def expired(self, now: float) -> bool:
+        return not self.holder_identity or (
+            self.renew_time + self.lease_duration_s <= now)
+
+
+_KINDS = {"Pod": Pod, "Node": Node, "ConfigMap": ConfigMap,
+          "PodGroup": PodGroup, "Lease": Lease}
 
 
 def deepcopy_obj(obj: Any) -> Any:

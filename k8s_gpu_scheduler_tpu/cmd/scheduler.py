@@ -26,17 +26,30 @@ import threading
 
 from ..config import SchedulerConfig
 from ..metrics.exporter import MetricsServer, Registry
-from ..plugins import GangPlugin, TPUPlugin
+from ..plugins import GangPlugin, PreemptionPlugin, TPUPlugin
 from ..sched import Profile, Scheduler, SliceReshaper
 
 log = logging.getLogger("tpu-scheduler")
 
 
 def build_scheduler(server, config: SchedulerConfig,
-                    metrics: Registry | None = None) -> Scheduler:
+                    metrics: Registry | None = None,
+                    leader_elect: bool = False) -> Scheduler:
     """Wire plugins + sidecar clients into a ready-to-start Scheduler."""
+    elector = None
+    if leader_elect:
+        import os
+        import socket
+
+        from ..sched import LeaderElector
+
+        elector = LeaderElector(
+            server,
+            identity=f"{socket.gethostname()}_{os.getpid()}",
+            name=config.scheduler_name,
+        )
     sched = Scheduler(server, profile=Profile(), config=config,
-                      metrics=metrics)
+                      metrics=metrics, elector=elector)
 
     registry = None
     try:
@@ -77,13 +90,18 @@ def build_scheduler(server, config: SchedulerConfig,
     except Exception as e:  # noqa: BLE001
         log.warning("metrics endpoint unavailable (%s)", e)
 
-    reshaper = SliceReshaper(sched.descriptor, registry=registry)
+    # Without a registry, reshape confirmation is simulated: take ~2 s so a
+    # demo shows the real applying→idle window instead of an instant flip.
+    reshaper = SliceReshaper(sched.descriptor, registry=registry,
+                             auto_confirm_delay_s=0.0 if registry else 2.0)
     tpu = TPUPlugin(sched.handle, registry=registry, prom=prom,
                     recommender=recommender, reshaper=reshaper)
     gang = GangPlugin(sched.handle)
+    preempt = PreemptionPlugin(sched.handle)
     sched.profile = Profile(
         pre_filter=[tpu, gang],
         filter=[tpu, gang],
+        post_filter=[preempt],
         score=[tpu, gang],
         reserve=[tpu, gang],
         permit=[gang],
@@ -144,6 +162,10 @@ def main(argv=None) -> int:
                              "--in-cluster; for dev/kind clusters)")
     parser.add_argument("--metrics-port", type=int, default=10251,
                         help="Prometheus exporter port (0 = disabled)")
+    parser.add_argument("--leader-elect", action="store_true",
+                        help="acquire a coordination Lease before scheduling "
+                             "(run replicas: 2 for HA — parity with "
+                             "deploy/scheduler.yaml:10-13 of the reference)")
     parser.add_argument("--once", action="store_true",
                         help="exit after the demo pods are all scheduled")
     parser.add_argument("-v", "--verbose", action="store_true")
@@ -165,7 +187,7 @@ def main(argv=None) -> int:
         server = KubeAPIServer(base_url=args.apiserver)
         log.info("connected to kube-apiserver at %s", server.base_url)
     config = SchedulerConfig.from_env()
-    sched = build_scheduler(server, config)
+    sched = build_scheduler(server, config, leader_elect=args.leader_elect)
 
     exporter = None
     if args.metrics_port:

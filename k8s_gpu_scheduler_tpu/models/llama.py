@@ -311,6 +311,38 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
         params = init_params(cfg, jax.random.PRNGKey(0))
         tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab)
     slo = float(os.environ.get("SLO", "0") or 0)
+
+    # Observed-throughput feedback (recommender/collector.py): when the pod
+    # carries WORKLOAD_NAME and the registry is reachable, every measured
+    # interval is published as an Observation — the collector folds it into
+    # the train matrix and the recommender's next prediction is anchored on
+    # reality instead of seed data.
+    publish = None
+    workload_name = os.environ.get("WORKLOAD_NAME", "")
+    if workload_name:
+        try:
+            from ..api.topology import TPUGen
+            from ..config import SchedulerConfig
+            from ..recommender.collector import publish_observation
+            from ..registry.client import Client as RegistryClient
+
+            rc = SchedulerConfig.from_env().registry
+            reg = RegistryClient(rc.host, rc.port, password=rc.password)
+            reg.ping()
+            chips = len([c for c in
+                         os.environ.get("TPU_VISIBLE_CHIPS", "").split(",")
+                         if c]) or n
+            try:
+                gen = TPUGen(os.environ.get("TPU_ACCELERATOR_TYPE", "")).name
+            except ValueError:
+                gen = "V5E"
+            column = f"{chips}P_{gen}"
+
+            def publish(qps: float) -> None:  # noqa: F811
+                publish_observation(reg, workload_name, column, qps)
+        except Exception as e:  # noqa: BLE001 — observability never kills work
+            print(f"observation publishing disabled: {e}", flush=True)
+
     if args.serve:
         # Real serving: prefill + KV-cache greedy decode (serving.py), one
         # jitted program per request shape. QPS is per decoded REQUEST;
@@ -331,6 +363,8 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
             print(f"llama serve qps={b / dt:.2f} "
                   f"decode_tok_s={b * max_new / dt:.1f} "
                   f"prefill_tok={b * Tp} slo={slo}", flush=True)
+            if publish is not None:
+                publish(b / dt)
             time.sleep(max(0.0, 1.0 - dt))
     batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
     opt = optax.adamw(3e-4)
@@ -341,9 +375,11 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
     while True:
         t0 = time.perf_counter()
         params, state, loss = step(params, state, batch)
+        tok_s = B * T / (time.perf_counter() - t0)
         print(f"llama pretrain worker={worker_id} "
-              f"tok/s={B * T / (time.perf_counter() - t0):.0f} "
-              f"loss={float(loss):.3f}", flush=True)
+              f"tok/s={tok_s:.0f} loss={float(loss):.3f}", flush=True)
+        if publish is not None and worker_id == 0:
+            publish(tok_s)
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -6,8 +6,12 @@
            side-effect-free: /root/reference/pkg/plugins/gpu_plugin/gpu_plugins.go).
 ``gang`` — Permit-based all-or-nothing admission with ICI-topology-aware
            node-set selection (no reference analogue; SURVEY.md §7.7).
+``preemption`` — PostFilter evicting lower-priority pods for a starving
+           high-priority pod (parity with the DefaultPreemption plugin the
+           reference inherits from kube-scheduler v1.21).
 """
 from .tpu import TPUPlugin
 from .gang import GangPlugin
+from .preemption import PreemptionPlugin
 
-__all__ = ["TPUPlugin", "GangPlugin"]
+__all__ = ["TPUPlugin", "GangPlugin", "PreemptionPlugin"]

@@ -288,6 +288,16 @@ class GangPlugin(
                 and p.spec.node_name
                 and p.status.phase == "Pending"
             ):
+                if not p.metadata.owner_references:
+                    # A bare pod has no controller to recreate it — deleting
+                    # it would be permanent, worse than the deadlock we're
+                    # clearing. Leave it; the operator owns its lifecycle.
+                    log.warning(
+                        "gang %s collapsed (%s): NOT evicting bare member %s "
+                        "(no ownerReferences)", group_key, reason,
+                        p.metadata.key,
+                    )
+                    continue
                 log.warning(
                     "gang %s collapsed (%s): evicting bound member %s",
                     group_key, reason, p.metadata.key,

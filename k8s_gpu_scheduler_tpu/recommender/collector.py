@@ -1,0 +1,140 @@
+"""Observation collector — folds measured throughput into the train matrix.
+
+Closes the feedback loop the reference only gestures at: its train matrices
+are hand-measured offline (.ods files, SURVEY.md §2 C11) and its retrain
+thread (recom_server.py:74-134) only ever re-reads the same file. Here:
+
+  workload (models/llama.py) → Observation in the registry
+      → Collector (this module) updates the configurations TSV
+          → RecommenderServer's md5-watch retrains (server.py _Table.refresh)
+              → next ImputeConfigurations reply is observation-anchored
+
+Cell update policy: a blank (imputed-only) cell takes the observation
+verbatim; a measured cell moves by EWMA (``alpha`` on the new sample) so one
+noisy run cannot wreck a row. New workloads append a row; observations for
+unknown columns are dropped (the column set IS the schema — slice shapes ×
+generations).
+
+The TSV write is atomic (tmp + rename) so the server never reads a torn
+file; its md5 check makes the handoff race-free.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+import time
+from typing import List, Optional
+
+from ..registry.inventory import OBSERVED_KEY_PREFIX, Observation
+from .server import load_matrix
+
+log = logging.getLogger(__name__)
+
+
+class Collector:
+    def __init__(self, registry, configurations_path: str,
+                 interval_s: float = 30.0, alpha: float = 0.5) -> None:
+        self.registry = registry
+        self.path = configurations_path
+        self.interval_s = interval_s
+        self.alpha = alpha
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one pass ----------------------------------------------------------
+    def collect_once(self) -> bool:
+        """Fold all registry observations into the TSV. True iff the file
+        changed (and therefore a retrain will trigger)."""
+        try:
+            keys = self.registry.get_keys(OBSERVED_KEY_PREFIX + "*")
+        except Exception as e:  # noqa: BLE001 — registry outage is routine
+            log.warning("collector: registry unavailable (%s)", e)
+            return False
+        observations: List[Observation] = []
+        for key in keys:
+            raw = self.registry.get(key)
+            if not raw:
+                continue
+            try:
+                observations.append(Observation.from_json(raw))
+            except (ValueError, TypeError) as e:
+                log.warning("collector: bad observation at %s: %s", key, e)
+        if not observations:
+            return False
+
+        labels, columns, X = load_matrix(self.path)
+        rows = [list(r) for r in X]
+        changed = False
+        for obs in observations:
+            if obs.qps <= 0 or not obs.workload:
+                continue
+            if obs.column not in columns:
+                log.warning("collector: unknown column %r (workload %s) — "
+                            "dropped", obs.column, obs.workload)
+                continue
+            j = columns.index(obs.column)
+            if obs.workload in labels:
+                i = labels.index(obs.workload)
+            else:
+                labels.append(obs.workload)
+                rows.append([float("nan")] * len(columns))
+                i = len(labels) - 1
+                changed = True
+            old = rows[i][j]
+            new = obs.qps if math.isnan(old) else (
+                self.alpha * obs.qps + (1 - self.alpha) * old)
+            if math.isnan(old) or abs(new - old) > 1e-9:
+                rows[i][j] = new
+                changed = True
+        if not changed:
+            return False
+        self._write(labels, columns, rows)
+        log.info("collector: folded %d observation(s) into %s",
+                 len(observations), self.path)
+        return True
+
+    def _write(self, labels: List[str], columns: List[str],
+               rows: List[List[float]]) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", newline="") as f:
+            f.write("workload\t" + "\t".join(columns) + "\n")
+            for label, row in zip(labels, rows):
+                cells = ["" if math.isnan(v) else f"{v:g}" for v in row]
+                f.write(label + "\t" + "\t".join(cells) + "\n")
+        os.replace(tmp, self.path)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "Collector":
+        self._thread = threading.Thread(
+            target=self._run, name="recom-collector", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.collect_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("collector pass failed")
+
+
+def publish_observation(registry, workload: str, column: str,
+                        qps: float) -> None:
+    """Workload-side helper: push one throughput sample (models call this
+    after each measured interval; failures are swallowed — observability
+    must never kill the workload)."""
+    from ..registry.inventory import observed_key
+
+    try:
+        registry.set(observed_key(workload, column),
+                     Observation(workload, column, qps, time.time()).to_json())
+    except Exception as e:  # noqa: BLE001
+        log.debug("observation publish failed: %s", e)

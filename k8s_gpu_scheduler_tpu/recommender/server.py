@@ -183,10 +183,11 @@ class RecommenderServer:
 def main() -> None:  # pragma: no cover — exercised via the CLI
     logging.basicConfig(level=logging.INFO)
     here = os.path.dirname(os.path.abspath(__file__))
+    configurations_path = os.environ.get(
+        "CONFIGURATIONS_DATA_PATH",
+        os.path.join(here, "data/configurations_train.tsv"))
     server = RecommenderServer(
-        configurations_path=os.environ.get(
-            "CONFIGURATIONS_DATA_PATH", os.path.join(here, "data/configurations_train.tsv")
-        ),
+        configurations_path=configurations_path,
         interference_path=os.environ.get(
             "INTERFERENCE_DATA_PATH", os.path.join(here, "data/interference_train.tsv")
         ),
@@ -194,7 +195,32 @@ def main() -> None:  # pragma: no cover — exercised via the CLI
         retrain_interval_s=float(os.environ.get("JOB_DELAY", "30")),
     ).start()
     print(f"recommender serving on :{server.port}", flush=True)
-    threading.Event().wait()
+    # Observation collector: when the registry is configured, measured
+    # workload throughput flows back into the train matrix (the md5-watch
+    # retrain above then picks it up). Optional with graceful degradation,
+    # like every sidecar in this framework.
+    collector = None
+    try:
+        from ..config import SchedulerConfig
+        from ..registry.client import Client as RegistryClient
+        from .collector import Collector
+
+        rc = SchedulerConfig.from_env().registry
+        reg = RegistryClient(rc.host, rc.port, password=rc.password)
+        reg.ping()
+        collector = Collector(
+            reg, configurations_path,
+            interval_s=float(os.environ.get("JOB_DELAY", "30")),
+        ).start()
+        print(f"collector polling registry at {rc.host}:{rc.port}",
+              flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(f"collector disabled (no registry: {e})", flush=True)
+    try:
+        threading.Event().wait()
+    finally:
+        if collector is not None:
+            collector.stop()
 
 
 if __name__ == "__main__":  # pragma: no cover

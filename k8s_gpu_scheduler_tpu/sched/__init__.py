@@ -11,6 +11,7 @@ from .framework import (
     PermitPlugin,
     Plugin,
     PostBindPlugin,
+    PostFilterPlugin,
     PreFilterPlugin,
     Profile,
     ReservePlugin,
@@ -18,6 +19,7 @@ from .framework import (
     Status,
     WaitingPod,
 )
+from .leaderelection import LeaderElector
 from .queue import SchedulingQueue, pod_priority
 from .reshaper import SliceReshaper
 from .scheduler import Scheduler
@@ -33,6 +35,7 @@ __all__ = [
     "PermitPlugin",
     "Plugin",
     "PostBindPlugin",
+    "PostFilterPlugin",
     "PreFilterPlugin",
     "Profile",
     "ReservePlugin",
@@ -43,4 +46,5 @@ __all__ = [
     "pod_priority",
     "SliceReshaper",
     "Scheduler",
+    "LeaderElector",
 ]

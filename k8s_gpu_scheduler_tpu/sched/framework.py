@@ -89,6 +89,18 @@ class FilterPlugin(Plugin):
         raise NotImplementedError
 
 
+class PostFilterPlugin(Plugin):
+    def post_filter(self, state: CycleState, pod,
+                    filtered_reasons: Dict[str, str]) -> Status:
+        """Runs only when Filter left NO feasible node. ``filtered_reasons``
+        maps node name → why it was rejected. Returning Success means the
+        plugin changed the cluster (e.g. preempted victims) such that a
+        retry may succeed — kube-scheduler's PostFilter/DefaultPreemption
+        contract (inherited whole by the reference via
+        cmd/scheduler/main.go:20-22)."""
+        raise NotImplementedError
+
+
 class ScorePlugin(Plugin):
     # weight multiplies this plugin's normalized scores in the final sum
     # (deploy/scheduler.yaml:8-24 gives the reference's plugin weight 10100).
@@ -129,6 +141,7 @@ class Profile:
 
     pre_filter: List[PreFilterPlugin] = field(default_factory=list)
     filter: List[FilterPlugin] = field(default_factory=list)
+    post_filter: List[PostFilterPlugin] = field(default_factory=list)
     score: List[ScorePlugin] = field(default_factory=list)
     reserve: List[ReservePlugin] = field(default_factory=list)
     permit: List[PermitPlugin] = field(default_factory=list)

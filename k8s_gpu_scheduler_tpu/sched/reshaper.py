@@ -52,11 +52,18 @@ class SliceReshaper:
         registry=None,
         poll_interval_s: float = 0.25,
         timeout_s: float = 60.0,
+        auto_confirm_delay_s: float = 0.0,
     ):
         self.descriptor = descriptor
         self.registry = registry
         self.poll_interval_s = poll_interval_s
         self.timeout_s = timeout_s
+        # No-registry mode: confirmation is SIMULATED (there is no agent to
+        # republish). Each request is loudly logged as such and confirms
+        # only after this delay — so a demo shows the applying→idle window
+        # instead of pretending hardware repartitioned instantly
+        # (VERDICT.md weak #7). Tests keep 0.0 for instant confirm.
+        self.auto_confirm_delay_s = auto_confirm_delay_s
         self._mu = threading.Lock()
         self._pending: Dict[str, _Pending] = {}
         self._stop = threading.Event()
@@ -169,6 +176,12 @@ class SliceReshaper:
         """Agent republished since the request → the host observed the new
         partitioning (UUID-change parity, gpu_plugins.go:436-452)."""
         if self.registry is None:
+            if time.time() - p.requested_at < self.auto_confirm_delay_s:
+                return False
+            log.warning(
+                "reshape of %s to %r confirmed WITHOUT a registry — "
+                "simulated confirmation, no agent observed the new "
+                "partitioning", p.node_name, p.target)
             return True
         try:
             raw = self.registry.get(node_key(p.node_name) + HEARTBEAT_SUFFIX)

@@ -51,6 +51,7 @@ class Scheduler:
         profile: Optional[Profile] = None,
         config: Optional[SchedulerConfig] = None,
         metrics: Optional[Registry] = None,
+        elector=None,
     ) -> None:
         self.config = config or SchedulerConfig()
         # Exported metrics — the BASELINE north-star (p50 schedule latency)
@@ -82,6 +83,11 @@ class Scheduler:
         self._binder = ThreadPoolExecutor(max_workers=16, thread_name_prefix="binder")
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Optional LeaderElector (sched/leaderelection.py): the cycle loop
+        # only pops while holding the lease; informers stay warm on standby
+        # replicas — kube-scheduler's HA shape, which the reference turns on
+        # via deploy config (deploy/scheduler.yaml:10-13).
+        self.elector = elector
         self._wire_informers()
 
     # -- informer wiring ---------------------------------------------------
@@ -141,11 +147,15 @@ class Scheduler:
         self.factory.informer("Pod")
         self.factory.start()
         self.factory.wait_for_cache_sync()
+        if self.elector is not None:
+            self.elector.start()
         self._thread = threading.Thread(target=self._run, name="sched-cycle", daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        if self.elector is not None:
+            self.elector.stop()
         self.queue.close()
         # Join the cycle thread FIRST so no new waiting pod can be parked
         # after the reject pass below — otherwise shutdown could block for
@@ -159,6 +169,9 @@ class Scheduler:
 
     def _run(self) -> None:
         while not self._stop.is_set():
+            if self.elector is not None and not self.elector.is_leader():
+                self._stop.wait(0.05)
+                continue
             pod = self.queue.pop(timeout=0.5)
             if pod is None:
                 continue
@@ -216,6 +229,24 @@ class Scheduler:
         if not feasible:
             msg = "; ".join(f"{n}: {r}" for n, r in sorted(reasons.items())) or "no nodes"
             self._record_failure(pod, f"0/{len(snapshot)} nodes available: {msg}")
+            # PostFilter (preemption): a plugin may free capacity so the
+            # requeued pod succeeds next cycle — the victims' delete events
+            # move it from backoff to active, and the priority queue pops
+            # the (higher-priority) preemptor before anything that could
+            # steal the freed chips.
+            for pl in self.profile.post_filter:
+                st = pl.post_filter(state, pod, reasons)
+                if st.ok:
+                    self._record_failure(
+                        pod, f"{pl.name}: preempted victims; waiting for "
+                             f"capacity release")
+                    self._m_attempts.inc(result="preempted")
+                    self.queue.add_unschedulable(pod)
+                    return
+                if st.message:
+                    self._record_failure(
+                        pod, f"0/{len(snapshot)} nodes available: {msg}; "
+                             f"{pl.name}: {st.message}")
             self._m_attempts.inc(result="unschedulable")
             self.queue.add_unschedulable(pod)
             return

@@ -4,6 +4,7 @@ integration (Score consumes agent-published utilization)."""
 import json
 import os
 import subprocess
+import sys
 import time
 
 import pytest
@@ -18,6 +19,7 @@ from k8s_gpu_scheduler_tpu.registry.inventory import (
 HERE = os.path.dirname(os.path.abspath(__file__))
 PROBE_DIR = os.path.join(HERE, "..", "native", "tpuprobe")
 PROBE_BIN = os.path.join(PROBE_DIR, "tpuprobe")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -178,3 +180,47 @@ class TestAgentSchedulerIntegration:
         s_idle, _ = plugin.score(state, pod, "idle")
         assert s_idle > s_busy
         assert s_idle == pytest.approx(95.0)
+
+
+class TestMetricsLogger:
+    """C18 parity: the offline poll-to-TSV tool
+    (reference parse_smi_metrics.py:25-42), over the prober fake seam."""
+
+    def test_samples_and_dumps_tsv(self, tmp_path):
+        from k8s_gpu_scheduler_tpu.agent.metrics_logger import (
+            COLUMNS, MetricsLogger,
+        )
+
+        fake = write_fake(tmp_path, [
+            {"device_id": 0, "duty_cycle": 0.5, "hbm_used": 10,
+             "hbm_total": 100},
+            {"device_id": 1, "duty_cycle": 0.25, "hbm_used": 20,
+             "hbm_total": 100},
+        ])
+        out = str(tmp_path / "metrics.tsv")
+        logger = MetricsLogger(Scraper(binary=PROBE_BIN, fake_file=fake), out,
+                               interval_s=0.01)
+        logger.run(max_samples=3)
+        path = logger.dump()
+        lines = open(path).read().strip().split("\n")
+        assert lines[0].split("\t") == list(COLUMNS)
+        assert len(lines) == 1 + 3 * 2  # header + samples × chips
+        first = lines[1].split("\t")
+        assert first[1] == "0" and float(first[2]) == 0.5
+
+    def test_cli_entrypoint(self, tmp_path):
+        fake = write_fake(tmp_path, [
+            {"device_id": 0, "duty_cycle": 0.75, "hbm_used": 1,
+             "hbm_total": 2},
+        ])
+        out = str(tmp_path / "cli.tsv")
+        env = dict(os.environ, TPUPROBE_BIN=PROBE_BIN)
+        proc = subprocess.run(
+            [sys.executable, "-m", "k8s_gpu_scheduler_tpu.agent.metrics_logger",
+             "-o", out, "--interval", "0.01", "--samples", "2",
+             "--fake", fake],
+            capture_output=True, env=env, timeout=30, cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr
+        lines = open(out).read().strip().split("\n")
+        assert len(lines) == 3

@@ -21,11 +21,14 @@ class FakeKube:
     """In-memory k8s REST server. Store: kind -> {ns/name: json-dict}."""
 
     def __init__(self):
-        self.store = {"pods": {}, "nodes": {}, "configmaps": {}, "podgroups": {}}
+        self.store = {"pods": {}, "nodes": {}, "configmaps": {},
+                      "podgroups": {}, "leases": {}}
         self.rv = 100
         self.mu = threading.Lock()
         self.watchers = []  # (plural, queue-like list, condition)
         self.binding_posts = []
+        self.gone_on_watch = False  # next watch connect gets a 410 ERROR
+        self.watch_idle_s = 10.0    # idle timeout before closing a watch
         fake = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -46,7 +49,7 @@ class FakeKube:
             def _route(self):
                 # /api/v1/<plural>, /api/v1/namespaces/<ns>/<plural>[/<name>[/binding]]
                 parts = [p for p in self.path.split("?")[0].split("/") if p]
-                if parts[:2] == ["apis", "scheduling.tpu.dev"]:
+                if parts[0] == "apis":
                     parts = parts[3:]  # strip apis/<group>/<version>
                 else:
                     parts = parts[2:]  # strip api/v1
@@ -88,15 +91,47 @@ class FakeKube:
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
+                # Real apiserver semantics: replay everything newer than the
+                # requested resourceVersion on connect, registered under the
+                # SAME lock — a create landing between the client's LIST and
+                # this connect is replayed, not lost (the round-2 fake
+                # ignored the param, making test_watch_streams_events racy).
+                req_rv = 0
+                for part in self.path.split("?", 1)[-1].split("&"):
+                    if part.startswith("resourceVersion="):
+                        v = part.split("=", 1)[1]
+                        req_rv = int(v) if v.isdigit() else 0
                 cond = threading.Condition()
                 events = []
                 with fake.mu:
+                    if fake.gone_on_watch:
+                        # Simulate etcd compaction: the rv is too old.
+                        fake.gone_on_watch = False
+                        body = json.dumps({
+                            "type": "ERROR",
+                            "object": {"kind": "Status", "code": 410,
+                                       "reason": "Expired",
+                                       "message": "too old resource version"},
+                        }).encode() + b"\n"
+                        self.wfile.write(f"{len(body):x}\r\n".encode()
+                                         + body + b"\r\n")
+                        self.wfile.write(b"0\r\n\r\n")
+                        self.wfile.flush()
+                        return
+                    for obj in sorted(fake.store[plural].values(),
+                                      key=lambda o: int(o["metadata"]
+                                                        ["resourceVersion"])):
+                        if int(obj["metadata"]["resourceVersion"]) > req_rv:
+                            events.append({
+                                "type": "ADDED",
+                                "object": json.loads(json.dumps(obj)),
+                            })
                     fake.watchers.append((plural, events, cond))
                 try:
                     while True:
                         with cond:
                             while not events:
-                                if not cond.wait(timeout=10):
+                                if not cond.wait(timeout=fake.watch_idle_s):
                                     return
                             ev = events.pop(0)
                         line = json.dumps(ev).encode() + b"\n"
@@ -146,6 +181,29 @@ class FakeKube:
                     fake._bump(obj)
                     fake._emit(plural, "MODIFIED", obj)
                 return self._send(200, obj)
+
+            def do_PUT(self):
+                plural, ns, name, _ = self._route()
+                body = self._body()
+                with fake.mu:
+                    obj = fake._get(plural, ns, name)
+                    if obj is None:
+                        return self._send(404, {})
+                    want = (body.get("metadata") or {}).get("resourceVersion")
+                    have = obj["metadata"]["resourceVersion"]
+                    if want is not None and str(want) != str(have):
+                        return self._send(409, {
+                            "reason": "Conflict",
+                            "message": f"rv mismatch {want} != {have}"})
+                    key = f"{obj['metadata'].get('namespace', 'default')}/{name}"
+                    if plural == "nodes":
+                        key = f"default/{name}"
+                    body["metadata"]["namespace"] = obj["metadata"].get(
+                        "namespace", "default")
+                    fake._bump(body)
+                    fake.store[plural][key] = body
+                    fake._emit(plural, "MODIFIED", body)
+                return self._send(200, body)
 
             def do_DELETE(self):
                 plural, ns, name, _ = self._route()
@@ -289,6 +347,92 @@ class TestAdapter:
         assert ev.obj.metadata.name == "p1"
         w.stop()
         assert w.next(timeout=1) is None
+
+    def test_mutate_deleted_annotation_reaches_server(self, fake):
+        """Merge-patch must null out keys the mutation fn removed —
+        otherwise a real apiserver keeps them forever (the reshaper clears
+        its state annotation exactly this way)."""
+        from k8s_gpu_scheduler_tpu.api.objects import ConfigMap, ObjectMeta
+
+        api = KubeAPIServer(base_url=fake.url)
+        fake.add_node("n1")
+
+        def set_ann(n):
+            n.metadata.annotations["tpu.sched/slice.reshape-state"] = "applying"
+            n.metadata.labels["x"] = "1"
+
+        api.mutate("Node", "n1", "default", set_ann)
+        assert api.get("Node", "n1").metadata.annotations[
+            "tpu.sched/slice.reshape-state"] == "applying"
+
+        def clear_ann(n):
+            n.metadata.annotations.pop("tpu.sched/slice.reshape-state")
+            n.metadata.labels.pop("x")
+
+        api.mutate("Node", "n1", "default", clear_ann)
+        node = api.get("Node", "n1")
+        assert "tpu.sched/slice.reshape-state" not in node.metadata.annotations
+        assert "x" not in node.metadata.labels
+
+        api.create(ConfigMap(metadata=ObjectMeta(name="cm"),
+                             data={"a": "1", "b": "2"}))
+        api.mutate("ConfigMap", "cm", "default",
+                   lambda cm: cm.data.pop("a"))
+        assert api.get("ConfigMap", "cm").data == {"b": "2"}
+
+    def test_notready_node_maps_to_no_conditions(self, fake):
+        """A node with Ready=False must NOT default to Ready (round-2 bug:
+        the filter never fired against real NotReady nodes)."""
+        fake.add_node("n1")
+        with fake.mu:
+            obj = fake.store["nodes"]["default/n1"]
+            obj["status"]["conditions"] = [
+                {"type": "Ready", "status": "False"},
+                {"type": "MemoryPressure", "status": "Unknown"},
+            ]
+            fake._bump(obj)
+        api = KubeAPIServer(base_url=fake.url)
+        node = api.get("Node", "n1")
+        assert "Ready" not in node.status.conditions
+        # No conditions at all (minimal fakes) still defaults to Ready.
+        with fake.mu:
+            obj["status"]["conditions"] = []
+            fake._bump(obj)
+        assert "Ready" in api.get("Node", "n1").status.conditions
+
+    def test_watch_410_relists_and_emits_diff(self, fake):
+        """Reflector semantics: on 410 Gone the watch re-lists and emits a
+        synthetic diff — including DELETED for objects that vanished while
+        the watch was blind."""
+        from tests.test_plugins import mk_pod
+
+        fake.watch_idle_s = 0.3
+        api = KubeAPIServer(base_url=fake.url)
+        api.create(mk_pod("p1"))
+        api.create(mk_pod("p2"))
+        w = api.watch("Pod", send_initial=True)
+        seen = {}
+        for _ in range(2):
+            ev = w.next(timeout=5)
+            seen[ev.obj.metadata.name] = ev.type
+        assert seen == {"p1": "ADDED", "p2": "ADDED"}
+        # p2 vanishes silently (no watch event), and the next reconnect is
+        # answered with 410: only the re-list diff can reveal the delete.
+        with fake.mu:
+            fake.store["pods"].pop("default/p2")
+            fake.gone_on_watch = True
+        events = []
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            ev = w.next(timeout=1)
+            if ev is None:
+                continue
+            events.append((ev.type, ev.obj.metadata.name))
+            if ("DELETED", "p2") in events and ("ADDED", "p1") in events:
+                break
+        w.stop()
+        assert ("DELETED", "p2") in events
+        assert ("ADDED", "p1") in events  # re-list re-asserts live objects
 
 
 class TestSchedulerOverREST:

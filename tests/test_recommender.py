@@ -1,10 +1,29 @@
 """Recommender tests: wire codec golden bytes, imputer recovery, hermetic
-in-process gRPC server+client, retrain-on-change, and the TPU plugin
-consuming the REAL service end to end."""
+in-process gRPC server+client, retrain-on-change, the TPU plugin consuming
+the REAL service end to end, and the observed-throughput feedback loop."""
+import math
+import os
 import time
 
 import numpy as np
 import pytest
+
+
+class FakeRegistryKV:
+    """Dict-backed stand-in for registry.Client (set/get/get_keys)."""
+
+    def __init__(self):
+        self.data = {}
+
+    def set(self, key, value):
+        self.data[key] = value
+
+    def get(self, key):
+        return self.data.get(key)
+
+    def get_keys(self, pattern="*"):
+        prefix = pattern.rstrip("*")
+        return [k for k in self.data if k.startswith(prefix)]
 
 from k8s_gpu_scheduler_tpu.recommender import (
     Client,
@@ -172,3 +191,108 @@ class TestRetrain:
                 assert c.impute_configurations("job_a")["1P_V5E"] == 250.0
         finally:
             srv.stop()
+
+
+class TestCollector:
+    """The observed-throughput feedback loop (recommender/collector.py):
+    workload publishes → collector folds into the TSV → md5 retrain →
+    imputation replies anchored on measurement (VERDICT.md weak #5)."""
+
+    @staticmethod
+    def _seed_tsv(tmp_path):
+        src = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..",
+            "k8s_gpu_scheduler_tpu", "recommender", "data",
+            "configurations_train.tsv")
+        dst = str(tmp_path / "conf.tsv")
+        with open(src) as f, open(dst, "w") as g:
+            g.write(f.read())
+        return dst
+
+    def test_observation_fills_blank_cell_and_shows_in_reply(self, tmp_path):
+        from k8s_gpu_scheduler_tpu.recommender.collector import (
+            Collector, publish_observation,
+        )
+        from k8s_gpu_scheduler_tpu.recommender.server import _Table, load_matrix
+
+        path = self._seed_tsv(tmp_path)
+        reg = FakeRegistryKV()
+        # llama3_8b_serve @ 4P_V5E is BLANK in the seed data.
+        labels, columns, X = load_matrix(path)
+        i, j = labels.index("llama3_8b_serve"), columns.index("4P_V5E")
+        assert math.isnan(X[i][j])
+
+        publish_observation(reg, "llama3_8b_serve", "4P_V5E", 13.5)
+        collector = Collector(reg, path, interval_s=999)
+        assert collector.collect_once()
+
+        table = _Table(path)  # fresh load = what the md5 retrain produces
+        result, cols = table.lookup("llama3-8b-serve-0")
+        assert result[cols.index("4P_V5E")] == pytest.approx(13.5)
+
+    def test_measured_cell_moves_by_ewma(self, tmp_path):
+        from k8s_gpu_scheduler_tpu.recommender.collector import (
+            Collector, publish_observation,
+        )
+        from k8s_gpu_scheduler_tpu.recommender.server import load_matrix
+
+        path = self._seed_tsv(tmp_path)
+        reg = FakeRegistryKV()
+        # 1P_V5E for llama3_8b_serve is 46 in the seed; observe 60.
+        publish_observation(reg, "llama3_8b_serve", "1P_V5E", 60.0)
+        Collector(reg, path, interval_s=999, alpha=0.5).collect_once()
+        labels, columns, X = load_matrix(path)
+        got = X[labels.index("llama3_8b_serve")][columns.index("1P_V5E")]
+        assert got == pytest.approx(0.5 * 60 + 0.5 * 46)
+
+    def test_new_workload_appends_row_unknown_column_dropped(self, tmp_path):
+        from k8s_gpu_scheduler_tpu.recommender.collector import (
+            Collector, publish_observation,
+        )
+        from k8s_gpu_scheduler_tpu.recommender.server import load_matrix
+
+        path = self._seed_tsv(tmp_path)
+        reg = FakeRegistryKV()
+        publish_observation(reg, "llama3_8b_pretrain", "8P_V5E", 81060.0)
+        publish_observation(reg, "llama3_8b_pretrain", "3P_WEIRD", 1.0)
+        Collector(reg, path, interval_s=999).collect_once()
+        labels, columns, X = load_matrix(path)
+        assert "llama3_8b_pretrain" in labels
+        assert "3P_WEIRD" not in columns
+        row = X[labels.index("llama3_8b_pretrain")]
+        assert row[columns.index("8P_V5E")] == pytest.approx(81060.0)
+        # Second pass with identical data: no spurious rewrite (md5 stable).
+        assert not Collector(reg, path, interval_s=999).collect_once()
+
+    def test_end_to_end_through_grpc_server(self, tmp_path):
+        """Full loop over the wire: gRPC reply BEFORE vs AFTER an
+        observation lands and the md5-watch retrains."""
+        from k8s_gpu_scheduler_tpu.recommender.client import Client
+        from k8s_gpu_scheduler_tpu.recommender.collector import (
+            Collector, publish_observation,
+        )
+        from k8s_gpu_scheduler_tpu.recommender.server import RecommenderServer
+
+        conf = self._seed_tsv(tmp_path)
+        intf = str(tmp_path / "intf.tsv")
+        with open(intf, "w") as f:
+            f.write("workload\tllama3_8b_serve\nllama3_8b_serve\t1.0\n")
+        server = RecommenderServer(conf, intf, port=0,
+                                   retrain_interval_s=0.1).start()
+        try:
+            client = Client("127.0.0.1", server.port)
+            before = client.impute_configurations("llama3-8b-serve-0")
+            assert before, "seed lookup must hit"
+            reg = FakeRegistryKV()
+            publish_observation(reg, "llama3_8b_serve", "4P_V5E", 13.5)
+            Collector(reg, conf, interval_s=999).collect_once()
+            deadline = time.time() + 5
+            after = {}
+            while time.time() < deadline:
+                after = client.impute_configurations("llama3-8b-serve-0")
+                if after.get("4P_V5E") == pytest.approx(13.5):
+                    break
+                time.sleep(0.1)
+            assert after.get("4P_V5E") == pytest.approx(13.5)
+        finally:
+            server.stop()
