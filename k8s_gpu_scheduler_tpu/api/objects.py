@@ -105,6 +105,13 @@ class PodSpec:
     scheduler_name: str = "tpu-scheduler"
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: List[str] = field(default_factory=list)
+    # StatefulSet pods carry hostname=<pod name> and subdomain=<serviceName>
+    # (the governing headless Service), giving them the stable DNS name
+    # <hostname>.<subdomain>.<ns>.svc — the address gang PostBind injects so
+    # jax.distributed.initialize can rendezvous POD-to-POD (node addresses
+    # are unreachable without hostNetwork).
+    hostname: str = ""
+    subdomain: str = ""
 
     def tpu_chips(self) -> int:
         return sum(c.resources.tpu_chips() for c in self.containers)

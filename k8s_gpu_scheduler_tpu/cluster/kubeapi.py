@@ -129,6 +129,8 @@ def pod_from_json(d: Dict) -> Pod:
             node_name=spec.get("nodeName", ""),
             scheduler_name=spec.get("schedulerName", "default-scheduler"),
             node_selector=spec.get("nodeSelector") or {},
+            hostname=spec.get("hostname", ""),
+            subdomain=spec.get("subdomain", ""),
         ),
         status=PodStatus(
             phase=status.get("phase", "Pending"),
@@ -226,6 +228,8 @@ def obj_to_json(obj: Any) -> Dict:
                 "schedulerName": obj.spec.scheduler_name,
                 "nodeName": obj.spec.node_name or None,
                 "nodeSelector": obj.spec.node_selector,
+                "hostname": obj.spec.hostname or None,
+                "subdomain": obj.spec.subdomain or None,
                 "containers": [{
                     "name": c.name, "image": c.image,
                     "env": [{"name": e.name, "value": e.value} for e in c.env],

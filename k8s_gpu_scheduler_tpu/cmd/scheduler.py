@@ -97,7 +97,7 @@ def build_scheduler(server, config: SchedulerConfig,
     tpu = TPUPlugin(sched.handle, registry=registry, prom=prom,
                     recommender=recommender, reshaper=reshaper)
     gang = GangPlugin(sched.handle)
-    preempt = PreemptionPlugin(sched.handle)
+    preempt = PreemptionPlugin(sched.handle, filter_plugins=[tpu, gang], tpu=tpu)
     sched.profile = Profile(
         pre_filter=[tpu, gang],
         filter=[tpu, gang],

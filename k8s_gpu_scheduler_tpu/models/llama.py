@@ -269,16 +269,12 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
     parser.add_argument("--max-new", type=int, default=64)
     args = parser.parse_args()
 
-    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
-    worker_id = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
-    if hostnames and len(hostnames.split(",")) > 1:
-        import jax as _jax
+    from ..parallel import distributed_init_from_env
 
-        _jax.distributed.initialize(
-            coordinator_address=f"{hostnames.split(',')[0]}:8476",
-            num_processes=len(hostnames.split(",")),
-            process_id=worker_id,
-        )
+    worker_id = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
+    # The injected TPU_WORKER_HOSTNAMES are pod-reachable addresses (stable
+    # pod DNS for StatefulSet gangs); worker 0 is the coordinator.
+    distributed_init_from_env()
     n = len(jax.devices())
     from ..parallel import MeshSpec, make_mesh
 
@@ -357,7 +353,12 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
         while True:
             t0 = time.perf_counter()
             out = handler(params, prompt)
-            int(out[0, -1])  # host sync on the full decode
+            # Host sync via block_until_ready: indexing a concrete element
+            # would fetch a global-array slice that is non-addressable on
+            # most workers when batch is sharded over (dp, fsdp) — jax
+            # raises and multi-host serving dies. block_until_ready syncs
+            # on every worker without materializing remote shards.
+            jax.block_until_ready(out)
             dt = time.perf_counter() - t0
             b = prompt.shape[0]
             print(f"llama serve qps={b / dt:.2f} "

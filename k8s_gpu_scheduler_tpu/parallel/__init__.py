@@ -8,6 +8,7 @@ meshes with dp/fsdp/tp/sp axes, NamedSharding rules, and sequence-parallel
 attention built on XLA collectives over ICI (ppermute ring, all_to_all
 Ulysses) rather than NCCL/MPI.
 """
+from .distributed import distributed_init_from_env, worker_addresses
 from .mesh import MeshSpec, make_mesh, named_sharding
 from .sharding import logical_axis_rules, shard_params_spec
 
@@ -17,4 +18,6 @@ __all__ = [
     "named_sharding",
     "logical_axis_rules",
     "shard_params_spec",
+    "distributed_init_from_env",
+    "worker_addresses",
 ]

@@ -1,0 +1,57 @@
+"""Multi-host bootstrap from the scheduler-injected environment.
+
+Gang PostBind (plugins/gang.py) writes three env vars through each member's
+EnvFrom ConfigMap:
+
+  TPU_WORKER_HOSTNAMES  comma-separated pod-reachable addresses, worker order
+  TPU_WORKER_ID         this member's index in that list
+  TPU_WORKER_COUNT      gang size
+
+``distributed_init_from_env`` turns them into a ``jax.distributed``
+rendezvous: worker 0's address is the coordinator. This is the consuming
+half of the contract — the producing half (stable pod DNS / pod IP instead
+of node names) is tested end-to-end in tests/test_plugins.py and the
+2-process CPU smoke in tests/test_distributed.py.
+
+The reference has no analogue: its injected env (CUDA_VISIBLE_DEVICES,
+gpu_plugins.go:910-920) is node-local, and its multi-node story is whatever
+NCCL/MPI launcher the workload brings. Here the scheduler IS the launcher.
+"""
+from __future__ import annotations
+
+import os
+from typing import Mapping, Optional
+
+COORDINATOR_PORT = 8476
+
+
+def worker_addresses(env: Optional[Mapping[str, str]] = None) -> list:
+    src = os.environ if env is None else env
+    return [h for h in src.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+
+
+def distributed_init_from_env(
+    env: Optional[Mapping[str, str]] = None,
+    coordinator_port: int = COORDINATOR_PORT,
+    **initialize_kwargs,
+) -> bool:
+    """Initialize jax.distributed from the gang env. Returns True iff a
+    multi-worker rendezvous was performed (single-worker / un-injected pods
+    return False and stay single-process). Extra kwargs pass through to
+    ``jax.distributed.initialize`` (tests pass ``cluster_detection_method``
+    etc.)."""
+    src = os.environ if env is None else env
+    addresses = worker_addresses(src)
+    if len(addresses) <= 1:
+        return False
+    worker_id = int(src.get("TPU_WORKER_ID", "0") or 0)
+    count = int(src.get("TPU_WORKER_COUNT", "") or len(addresses))
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"{addresses[0]}:{coordinator_port}",
+        num_processes=count,
+        process_id=worker_id,
+        **initialize_kwargs,
+    )
+    return True

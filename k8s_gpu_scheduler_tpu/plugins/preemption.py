@@ -8,25 +8,34 @@ pod may evict lower-priority pods to make room. Round 2 had priority
 but no preemption — a full cluster starved a high-priority pod forever
 (VERDICT.md missing #1).
 
-Victim selection (DefaultPreemption's shape, simplified to the one extended
-resource this scheduler manages):
+Victim selection (DefaultPreemption's shape, extended for TPU topology):
 
 - only pods with strictly LOWER priority are candidates;
 - gang members are never victims (killing one collapses the whole gang —
   the gang plugin's quorum logic owns that lifecycle, plugins/gang.py);
 - pods without a controller owner are never victims (a bare pod is gone
-  forever; StatefulSet/Job/Deployment pods come back — the same guard
-  VERDICT.md weak #6 asked of gang eviction);
-- candidate nodes must match the pod's nodeSelector and be Ready — if a
-  node failed Filter for a *non-capacity* reason, evicting pods there
-  cannot help;
-- per node, victims are taken lowest-priority-first until the pod fits;
-  the chosen node minimizes (victim count, summed victim priority).
+  forever; StatefulSet/Job/Deployment pods come back);
+- **topology-aware**: the freed chips must form a *partition* the
+  preemptor fits (the sub-slice carving from plugins/tpu.py). Freeing 4
+  chips spread over two 2x2 partitions of a v5p board does not make a
+  4-chip pod schedulable — victims are chosen per-partition so eviction
+  only happens where it produces a usable hole;
+- **dry-run Filter**: before any eviction, the full Filter chain is re-run
+  against a hypothetical NodeInfo with the victims removed (kube's
+  DefaultPreemption runs RunFilterPlugins on the victims-less snapshot the
+  same way). This generalizes the r3 advisor finding: a node rejected for
+  a non-capacity reason (NotReady, selector mismatch, reshape 'applying',
+  gang slice-group conflict) can never produce destructive deletes that
+  don't help;
+- per node, victims are taken lowest-priority-first; the chosen node
+  minimizes (victim count, summed victim priority).
 
-On success the victims are deleted through the API server and the pod is
-requeued: their DELETE events release chips in the cache and flip the
-queue, and the priority queue pops the preemptor before lower-priority
-work can steal the freed capacity.
+On success the victims are deleted through the API server, the preemptor is
+**nominated** to the node (framework.Nominator — kube's
+pod.status.nominatedNodeName), and the pod is requeued: the victims' DELETE
+events release chips in the cache and flip the queue, other pods' Filter
+counts the nominated chips as taken for equal-or-lower-priority rivals, and
+the preemptor's next cycle lands on its nominated node.
 """
 from __future__ import annotations
 
@@ -44,10 +53,18 @@ log = logging.getLogger(__name__)
 class PreemptionPlugin(PostFilterPlugin):
     name = "Preemption"
 
-    def __init__(self, handle) -> None:
+    def __init__(self, handle, filter_plugins: Optional[list] = None,
+                 tpu=None) -> None:
+        """``filter_plugins``: the profile's Filter chain, re-run against the
+        victims-removed NodeInfo (dry run). ``tpu``: the TPUPlugin, borrowed
+        for partition carving so victim selection is topology-aware. Both
+        optional — without them selection degrades to the node-level
+        capacity greedy."""
         self.handle = handle
+        self.filter_plugins = filter_plugins or []
+        self.tpu = tpu
 
-    # -- PostFilter --------------------------------------------------------
+    # -- PostFilter ----------------------------------------------------------
     def post_filter(self, state: CycleState, pod: Pod,
                     filtered_reasons: Dict[str, str]) -> Status:
         prio = pod_priority(pod)
@@ -60,7 +77,7 @@ class PreemptionPlugin(PostFilterPlugin):
 
         best: Optional[Tuple[Tuple[int, int], str, List[Pod]]] = None
         for info in self.handle.cache.snapshot().values():
-            victims = self._victims_for(pod, prio, need, info)
+            victims = self._victims_for(state, pod, prio, need, info)
             if victims is None:
                 continue
             cost = (len(victims), sum(pod_priority(v) for v in victims))
@@ -81,24 +98,25 @@ class PreemptionPlugin(PostFilterPlugin):
             except Exception as e:  # noqa: BLE001 — victim may be gone already
                 log.warning("preemption delete %s failed: %s",
                             v.metadata.key, e)
+        # Reserve the hole: Filter subtracts nominated chips for rivals of
+        # equal/lower priority, and the preemptor's own next cycle prefers
+        # this node (scheduler._select_node).
+        self.handle.nominator.nominate(pod, node_name)
         state.write("preemption/node", node_name)
         return Status.success()
 
-    # -- victim selection --------------------------------------------------
-    def _victims_for(self, pod: Pod, prio: int, need: int,
+    # -- victim selection ------------------------------------------------------
+    def _victims_for(self, state: CycleState, pod: Pod, prio: int, need: int,
                      info: NodeInfo) -> Optional[List[Pod]]:
         """Minimal victim list on this node, or None if preemption there
         cannot make the pod schedulable."""
-        node = info.node
-        for k, v in pod.spec.node_selector.items():
-            if node.metadata.labels.get(k) != v:
-                return None
-        if "Ready" not in node.status.conditions:
+        if info.allocatable_tpu < need:
+            # Eviction can never create capacity the node doesn't have.
             return None
-        free = info.free_tpu
-        if free >= need:
+        if info.free_tpu >= need:
             # Capacity was never the problem on this node — Filter rejected
-            # it for a reason eviction cannot fix.
+            # it for a reason eviction cannot fix (selector, NotReady,
+            # reshape in flight, gang conflict, a rival's nomination).
             return None
         candidates = sorted(
             (p for p in info.pods
@@ -107,6 +125,77 @@ class PreemptionPlugin(PostFilterPlugin):
              and p.metadata.owner_references),
             key=pod_priority,
         )
+        victims = self._partition_victims(info, need, candidates)
+        if victims is None:
+            return None
+        if not self._dry_run_filter(state, pod, info, victims):
+            return None
+        return victims
+
+    def _partition_victims(self, info: NodeInfo, need: int,
+                           candidates: List[Pod]) -> Optional[List[Pod]]:
+        """Pick victims so the freed chips form a usable hole.
+
+        With the TPU plugin available the node's board is carved into its
+        current partitions and victims are taken within the single partition
+        that frees >= ``need`` chips at minimal cost. Without it (or when
+        the node has no topology labels), falls back to node-level greedy."""
+        parts = self._partitions_of(info)
+        if not parts:
+            return self._greedy_victims(info.free_tpu, need, candidates)
+
+        evictable = {p.metadata.uid for p in candidates}
+        # Attribute every chip-consuming resident to a partition (the same
+        # ConfigMap-readback attribution Score uses, tpu.py _placed_slos).
+        by_part: Dict[str, List[Pod]] = {p.key: [] for p in parts}
+        for resident in info.pods:
+            if resident.spec.tpu_chips() == 0:
+                continue
+            key = self.tpu._assigned_partition(resident, info.name)
+            if key is None or key not in by_part:
+                key = parts[0].key  # conservative, mirrors _placed_slos
+            by_part[key].append(resident)
+
+        best_cost: Optional[Tuple[int, int]] = None
+        best_victims: Optional[List[Pod]] = None
+        for part in parts:
+            if len(part.chip_ids) < need:
+                continue  # this hole can never fit the preemptor
+            occupants = by_part[part.key]
+            free = len(part.chip_ids) - sum(
+                r.spec.tpu_chips() for r in occupants)
+            victims: List[Pod] = []
+            for r in sorted(occupants, key=pod_priority):
+                if free >= need:
+                    break
+                if r.metadata.uid not in evictable:
+                    continue
+                victims.append(r)
+                free += r.spec.tpu_chips()
+            if free < need:
+                continue  # blocked by higher-priority/gang/bare occupants
+            cost = (len(victims), sum(pod_priority(v) for v in victims))
+            if best_cost is None or cost < best_cost:
+                best_cost, best_victims = cost, victims
+        return best_victims
+
+    def _partitions_of(self, info: NodeInfo):
+        if self.tpu is None:
+            return []
+        topo = info.slice_topology()
+        if topo is None:
+            return []
+        try:
+            inv = self.tpu._inventory(info.name)
+            return self.tpu._partitions(info, topo, inv)
+        except Exception:  # noqa: BLE001 — degrade to node-level greedy
+            return []
+
+    @staticmethod
+    def _greedy_victims(free: int, need: int,
+                        candidates: List[Pod]) -> Optional[List[Pod]]:
+        if free >= need:
+            return None  # capacity was never the problem here
         victims: List[Pod] = []
         for v in candidates:
             victims.append(v)
@@ -114,3 +203,29 @@ class PreemptionPlugin(PostFilterPlugin):
             if free >= need:
                 return victims
         return None
+
+    # -- dry run ---------------------------------------------------------------
+    def _dry_run_filter(self, state: CycleState, pod: Pod, info: NodeInfo,
+                        victims: List[Pod]) -> bool:
+        """Re-run the Filter chain against this node with the victims gone —
+        kube's DefaultPreemption contract. Catches every non-capacity
+        rejection (NotReady, selector, reshape 'applying', gang slice-group)
+        without parsing reason strings. No chain wired → legacy checks."""
+        if not self.filter_plugins:
+            node = info.node
+            for k, v in pod.spec.node_selector.items():
+                if node.metadata.labels.get(k) != v:
+                    return False
+            return "Ready" in node.status.conditions
+        gone = {v.metadata.uid for v in victims}
+        hypo = info.shallow_copy()
+        hypo.pods = [p for p in hypo.pods if p.metadata.uid not in gone]
+        hypo.requested_tpu -= sum(v.spec.tpu_chips() for v in victims)
+        shadow = state.clone()
+        for pl in self.filter_plugins:
+            try:
+                if not pl.filter(shadow, pod, hypo).ok:
+                    return False
+            except Exception:  # noqa: BLE001 — a crashing filter is a veto
+                return False
+        return True

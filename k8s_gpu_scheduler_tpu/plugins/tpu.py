@@ -212,9 +212,10 @@ class TPUPlugin(
             return Status.success()
         if info.allocatable_tpu == 0:
             return Status.unschedulable("node has no TPUs")
-        if info.free_tpu < chips:
+        free = info.free_tpu - self._nominated_chips(pod, info)
+        if free < chips:
             return Status.unschedulable(
-                f"insufficient TPU chips: want {chips}, free {info.free_tpu}"
+                f"insufficient TPU chips: want {chips}, free {free}"
             )
         topo = info.slice_topology()
         if topo is None:
@@ -225,6 +226,28 @@ class TPUPlugin(
             )
         state.write(f"tpu.nodeinfo/{info.name}", info)
         return Status.success()
+
+    def _nominated_chips(self, pod: Pod, info: NodeInfo) -> int:
+        """Chips reserved on this node for pods nominated by preemption —
+        kube-scheduler's addNominatedPods: when filtering pod P, nominated
+        pods with priority >= P's count as already placed (their capacity
+        was freed FOR them), so P cannot snipe it; lower-priority nominees
+        yield to P exactly as they would on a real node."""
+        from ..sched.queue import pod_priority
+
+        nominator = getattr(self.handle, "nominator", None)
+        if nominator is None:
+            return 0
+        my_prio = pod_priority(pod)
+        my_uid = pod.metadata.uid
+        placed = {p.metadata.uid for p in info.pods}
+        return sum(
+            np.spec.tpu_chips()
+            for np in nominator.pods_on(info.name)
+            if np.metadata.uid != my_uid
+            and np.metadata.uid not in placed
+            and pod_priority(np) >= my_prio
+        )
 
     # -- Score -------------------------------------------------------------
     def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[float, Status]:

