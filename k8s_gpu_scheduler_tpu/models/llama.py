@@ -271,10 +271,13 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
 
     from ..parallel import distributed_init_from_env
 
-    worker_id = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
     # The injected TPU_WORKER_HOSTNAMES are pod-reachable addresses (stable
     # pod DNS for StatefulSet gangs); worker 0 is the coordinator.
     distributed_init_from_env()
+    # Rank comes from the live runtime, NOT the TPU_WORKER_ID scalar: gangs
+    # whose members share one EnvFrom ConfigMap all read the last-written
+    # id (distributed.py self_worker_id) — process_index is always ours.
+    worker_id = jax.process_index()
     n = len(jax.devices())
     from ..parallel import MeshSpec, make_mesh
 

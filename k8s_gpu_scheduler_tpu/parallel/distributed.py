@@ -30,6 +30,32 @@ def worker_addresses(env: Optional[Mapping[str, str]] = None) -> list:
     return [h for h in src.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
 
 
+def self_worker_id(
+    addresses: list, env: Optional[Mapping[str, str]] = None
+) -> Optional[int]:
+    """This worker's index in the gang address list, derived from its OWN
+    identity: the entry whose first DNS label equals this pod's hostname
+    ($HOSTNAME == pod name inside the container).
+
+    This is the authoritative id for gangs whose members share one EnvFrom
+    ConfigMap (deploy/workloads/llama-gang.yaml): each member's PostBind
+    writes its scalar TPU_WORKER_ID into the SAME map, so the last write
+    wins and every worker would read an identical id — a guaranteed
+    rendezvous deadlock. The address list, by contrast, is identical across
+    members by construction (plugins/gang.py _member_address is a pure
+    function of pod spec + node assignment), so matching ourselves against
+    it is race-free. Returns None when no entry matches (plain-pod
+    gangs injected with node addresses)."""
+    src = os.environ if env is None else env
+    hostname = src.get("HOSTNAME", "")
+    if not hostname:
+        return None
+    for i, addr in enumerate(addresses):
+        if addr == hostname or addr.split(".", 1)[0] == hostname:
+            return i
+    return None
+
+
 def distributed_init_from_env(
     env: Optional[Mapping[str, str]] = None,
     coordinator_port: int = COORDINATOR_PORT,
@@ -39,12 +65,18 @@ def distributed_init_from_env(
     multi-worker rendezvous was performed (single-worker / un-injected pods
     return False and stay single-process). Extra kwargs pass through to
     ``jax.distributed.initialize`` (tests pass ``cluster_detection_method``
-    etc.)."""
+    etc.).
+
+    process_id preference: self-derived from $HOSTNAME vs the address list
+    (shared-ConfigMap-safe — see self_worker_id), then the injected
+    TPU_WORKER_ID scalar (per-pod-ConfigMap gangs, hostNetwork gangs)."""
     src = os.environ if env is None else env
     addresses = worker_addresses(src)
     if len(addresses) <= 1:
         return False
-    worker_id = int(src.get("TPU_WORKER_ID", "0") or 0)
+    worker_id = self_worker_id(addresses, src)
+    if worker_id is None:
+        worker_id = int(src.get("TPU_WORKER_ID", "0") or 0)
     count = int(src.get("TPU_WORKER_COUNT", "") or len(addresses))
     import jax
 

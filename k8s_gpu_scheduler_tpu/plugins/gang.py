@@ -343,13 +343,19 @@ class GangPlugin(
         Addresses are pod-reachable, not node names: a pod doesn't listen on
         its node's address without hostNetwork, so a gang injected with node
         names places fine and then hangs at rendezvous (VERDICT.md r3
-        missing #1). Preference per member: stable pod DNS
+        missing #1). Per member: stable pod DNS
         ``<hostname>.<subdomain>.<ns>.svc`` (StatefulSet pods always carry
         hostname+subdomain — deploy/workloads/llama-gang.yaml's headless
-        Service provides the records), then the pod IP if already assigned,
-        then the node name as a last resort (hostNetwork pods). The
-        reference never faces this class of bug: its injected env,
-        CUDA_VISIBLE_DEVICES, is node-local (gpu_plugins.go:910-920)."""
+        Service provides the records), else the node name (correct only for
+        hostNetwork pods, which is what plain-pod gangs must use — there is
+        no stable pod address before the pod starts). Deliberately NO pod-IP
+        fallback: IPs are assigned after binding, so early members' PostBind
+        would see no IPs and late members' would — each member would inject
+        a DIFFERENT list (different coordinator!) and the rendezvous hangs.
+        Both remaining inputs (pod spec fields, node assignment) are fixed
+        before any PostBind runs, so every member derives the identical
+        list. The reference never faces this class of bug: its injected
+        env, CUDA_VISIBLE_DEVICES, is node-local (gpu_plugins.go:910-920)."""
         group: Optional[PodGroup] = state.read("gang.group")
         if group is None:
             return
@@ -393,11 +399,11 @@ class GangPlugin(
 
     @staticmethod
     def _member_address(peer: Optional[Pod], node_name: str) -> str:
-        """One gang member's reachable address (see post_bind docstring)."""
-        if peer is not None:
+        """One gang member's reachable address (see post_bind docstring).
+        Must be a pure function of pod SPEC fields + node assignment so all
+        members derive the same list — never of late-bound status like
+        pod IP."""
+        if peer is not None and peer.spec.subdomain:
             host = peer.spec.hostname or peer.metadata.name
-            if peer.spec.subdomain:
-                return f"{host}.{peer.spec.subdomain}.{peer.metadata.namespace}.svc"
-            if peer.status.pod_ip:
-                return peer.status.pod_ip
+            return f"{host}.{peer.spec.subdomain}.{peer.metadata.namespace}.svc"
         return node_name

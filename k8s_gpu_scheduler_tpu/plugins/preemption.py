@@ -113,10 +113,20 @@ class PreemptionPlugin(PostFilterPlugin):
         if info.allocatable_tpu < need:
             # Eviction can never create capacity the node doesn't have.
             return None
-        if info.free_tpu >= need:
+        # Effective free capacity mirrors Filter's view: chips held by
+        # equal-or-higher-priority nominations are NOT free (evicting
+        # residents can still help around them), so a node whose raw
+        # free_tpu looks sufficient may genuinely need victims. Without
+        # the subtraction such a node is skipped as "capacity was never
+        # the problem" and the preemptor starves behind a stuck rival
+        # nomination.
+        nominated = (self.tpu._nominated_chips(pod, info)
+                     if self.tpu is not None else 0)
+        free = info.free_tpu - nominated
+        if free >= need:
             # Capacity was never the problem on this node — Filter rejected
             # it for a reason eviction cannot fix (selector, NotReady,
-            # reshape in flight, gang conflict, a rival's nomination).
+            # reshape in flight, gang conflict).
             return None
         candidates = sorted(
             (p for p in info.pods
@@ -125,7 +135,8 @@ class PreemptionPlugin(PostFilterPlugin):
              and p.metadata.owner_references),
             key=pod_priority,
         )
-        victims = self._partition_victims(info, need, candidates)
+        victims = self._partition_victims(info, need, candidates, free,
+                                          nominated)
         if victims is None:
             return None
         if not self._dry_run_filter(state, pod, info, victims):
@@ -133,28 +144,28 @@ class PreemptionPlugin(PostFilterPlugin):
         return victims
 
     def _partition_victims(self, info: NodeInfo, need: int,
-                           candidates: List[Pod]) -> Optional[List[Pod]]:
+                           candidates: List[Pod], node_free: int,
+                           nominated: int = 0) -> Optional[List[Pod]]:
         """Pick victims so the freed chips form a usable hole.
 
         With the TPU plugin available the node's board is carved into its
         current partitions and victims are taken within the single partition
-        that frees >= ``need`` chips at minimal cost. Without it (or when
-        the node has no topology labels), falls back to node-level greedy."""
+        that frees >= ``need`` chips at minimal cost. ``nominated`` chips
+        (reserved for equal/higher-priority nominees) are debited from each
+        partition's free count — a nomination isn't partition-attributed,
+        so this is conservative per partition; the dry-run Filter is the
+        final arbiter either way. Without the TPU plugin (or topology
+        labels), falls back to node-level greedy over ``node_free``
+        (nomination-adjusted free chips)."""
         parts = self._partitions_of(info)
         if not parts:
-            return self._greedy_victims(info.free_tpu, need, candidates)
+            return self._greedy_victims(node_free, need, candidates)
 
         evictable = {p.metadata.uid for p in candidates}
-        # Attribute every chip-consuming resident to a partition (the same
-        # ConfigMap-readback attribution Score uses, tpu.py _placed_slos).
-        by_part: Dict[str, List[Pod]] = {p.key: [] for p in parts}
-        for resident in info.pods:
-            if resident.spec.tpu_chips() == 0:
-                continue
-            key = self.tpu._assigned_partition(resident, info.name)
-            if key is None or key not in by_part:
-                key = parts[0].key  # conservative, mirrors _placed_slos
-            by_part[key].append(resident)
+        # Attribute every chip-consuming resident to a partition — the ONE
+        # attribution rule shared with Score (tpu.residents_by_partition),
+        # ConfigMap fetches memoized inside.
+        by_part = self.tpu.residents_by_partition(info, parts)
 
         best_cost: Optional[Tuple[int, int]] = None
         best_victims: Optional[List[Pod]] = None
@@ -163,7 +174,7 @@ class PreemptionPlugin(PostFilterPlugin):
                 continue  # this hole can never fit the preemptor
             occupants = by_part[part.key]
             free = len(part.chip_ids) - sum(
-                r.spec.tpu_chips() for r in occupants)
+                r.spec.tpu_chips() for r in occupants) - nominated
             victims: List[Pod] = []
             for r in sorted(occupants, key=pod_priority):
                 if free >= need:

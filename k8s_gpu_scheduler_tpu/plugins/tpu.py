@@ -336,9 +336,12 @@ class TPUPlugin(
         if decision.rightsized_config:
             # MPS_<node> analogue (gpu_plugins.go:653-666).
             data[f"RIGHTSIZE_{node_name}"] = decision.rightsized_config
-        if decision.hbm_limit_bytes:
+        if decision.duty_pct < 100:
             # CUDA_MPS_PINNED_DEVICE_MEM_LIMIT / ACTIVE_THREAD_PERCENTAGE
-            # analogues (gpu_plugins.go:896-904).
+            # analogues (gpu_plugins.go:896-904). Keyed on duty_pct, not the
+            # HBM value: the HBM debit can legitimately reach 0 on a
+            # fully-occupied partition, and a shared-host pod must still get
+            # its caps then — 0 free is a cap, not an exemption.
             data[ENV_HBM_LIMIT] = str(decision.hbm_limit_bytes)
             data[ENV_DUTY_PCT] = str(decision.duty_pct)
         data[ENV_WORKER_ID] = str(decision.worker_id)
@@ -380,16 +383,18 @@ class TPUPlugin(
 
         decision = Decision(node_name=node_name, accelerator=topo.gen.value)
         if slo <= 0 or self.recommender is None:
-            # No SLO or no predictor: inverse-utilization score, first
-            # fitting partition.
-            decision.partition = self._pick_free_partition(info, partitions, chips_wanted)
-            self._fill_sharing_limits(decision, topo, partitions)
+            # No SLO or no predictor: inverse-utilization score, emptiest
+            # fitting partition (per-chip duty/HBM break pod-count ties).
+            decision.partition = self._pick_free_partition(
+                info, partitions, chips_wanted, inv)
+            self._fill_sharing_limits(decision, topo, partitions, inv)
             return decision, self._utilization_score(node_name, inv=inv)
 
-        score, best = self._slo_score(info, topo, partitions, pod, slo, chips_wanted)
-        decision.partition = best or self._pick_free_partition(info, partitions, chips_wanted)
+        score, best = self._slo_score(info, topo, partitions, pod, slo, chips_wanted, inv)
+        decision.partition = best or self._pick_free_partition(
+            info, partitions, chips_wanted, inv)
         decision.rightsized_config = self._rightsize(topo, slo, chips_wanted)
-        self._fill_sharing_limits(decision, topo, partitions)
+        self._fill_sharing_limits(decision, topo, partitions, inv)
         return decision, score
 
     def _slo_score(
@@ -400,9 +405,13 @@ class TPUPlugin(
         pod: Pod,
         slo: float,
         chips_wanted: int,
+        inv: Optional[NodeInventory] = None,
     ) -> Tuple[float, Optional[Partition]]:
         """The hot loop (gpu_plugins.go:561-756): for every partition, blend
-        SLO slack of already-placed pods and of the incoming pod; argmax."""
+        SLO slack of already-placed pods and of the incoming pod; argmax.
+        Per-chip duty cycle breaks SLO-score ties so the emptier sub-slice
+        wins — the per-UUID DCGM richness (gpu_plugins.go:162-236) the
+        reference feeds its loop and r3 published but ignored."""
         assert self.recommender is not None
         gen = gen_short(topo.gen)
         parts_count = max(len(partitions), 1)
@@ -410,6 +419,7 @@ class TPUPlugin(
         placed = self._placed_slos(info, partitions)
 
         best_score, best_part = float(MIN_NODE_SCORE), None
+        best_duty = float("inf")
         incoming_conf = self.recommender.impute_configurations(pod.metadata.name)
         incoming_intf = self.recommender.impute_interference(
             f"{pod.metadata.name}_{gen}"
@@ -466,8 +476,11 @@ class TPUPlugin(
                     pos_n += 1
 
             part_score = combine_terms(pos_sum, pos_n, neg_sum, neg_n)
-            if part_score > best_score:
-                best_score, best_part = part_score, part
+            duty, _, _ = self._partition_load(part, inv)
+            if part_score > best_score or (
+                part_score == best_score and duty < best_duty
+            ):
+                best_score, best_part, best_duty = part_score, part, duty
         return best_score, best_part
 
     def _rightsize(self, topo: SliceTopology, slo: float, chips_wanted: int) -> str:
@@ -537,62 +550,133 @@ class TPUPlugin(
             for i in range(count)
         ]
 
+    def residents_by_partition(
+        self, info: NodeInfo, partitions: List[Partition]
+    ) -> Dict[str, List[Pod]]:
+        """partition key → chip-consuming residents, attributed by ConfigMap
+        readback ({nodeName: partition} written at PostBind); pods with no
+        assignment yet go to the first partition so its capacity still
+        counts (conservative). The ONE attribution rule — Score
+        (_placed_slos) and preemption victim selection both call this, so
+        they can never diverge. ConfigMap fetches are memoized per call:
+        gang members share one map, and each fetch is an API-server
+        round-trip (cluster/resources.py get_configmap)."""
+        fallback = partitions[0].key if partitions else ""
+        out: Dict[str, List[Pod]] = {p.key: [] for p in partitions}
+        cm_cache: Dict[Tuple[str, str], object] = {}
+        for p in info.pods:
+            if p.spec.tpu_chips() == 0:
+                continue
+            key = self._assigned_partition(p, info.name, cm_cache)
+            if key is None or key not in out:
+                key = fallback
+            out.setdefault(key, []).append(p)
+        return out
+
     def _placed_slos(
         self, info: NodeInfo, partitions: List[Partition]
     ) -> Dict[str, Dict[str, float]]:
         """partition key → {pod name → SLO} for pods already on the node —
-        GetSLOs parity (gpu_plugins.go:87-160), reading each pod's EnvFrom
-        ConfigMap back for its assigned partition."""
+        GetSLOs parity (gpu_plugins.go:87-160)."""
         out: Dict[str, Dict[str, float]] = {}
-        for p in info.pods:
-            if p.spec.tpu_chips() == 0:
-                continue
-            key = self._assigned_partition(p, info.name)
-            if key is None:
-                # Not yet injected — attribute to the first partition so its
-                # capacity still counts (conservative).
-                key = partitions[0].key if partitions else ""
-            out.setdefault(key, {})[p.metadata.name] = pod_slo(p)
+        for key, residents in self.residents_by_partition(info, partitions).items():
+            for p in residents:
+                out.setdefault(key, {})[p.metadata.name] = pod_slo(p)
         return out
 
-    def _assigned_partition(self, pod: Pod, node_name: str) -> Optional[str]:
+    def _assigned_partition(
+        self,
+        pod: Pod,
+        node_name: str,
+        cm_cache: Optional[Dict] = None,
+    ) -> Optional[str]:
         for c in pod.spec.containers:
             for ref in c.env_from:
-                try:
-                    cm = self.handle.descriptor.get_configmap(
-                        ref.name, pod.metadata.namespace
-                    )
-                except Exception:  # noqa: BLE001 — NotFound or API hiccup
-                    continue
-                if node_name in cm.data:
+                cache_key = (ref.name, pod.metadata.namespace)
+                if cm_cache is not None and cache_key in cm_cache:
+                    cm = cm_cache[cache_key]
+                else:
+                    try:
+                        cm = self.handle.descriptor.get_configmap(
+                            ref.name, pod.metadata.namespace
+                        )
+                    except Exception:  # noqa: BLE001 — NotFound or API hiccup
+                        cm = None
+                    if cm_cache is not None:
+                        cm_cache[cache_key] = cm
+                if cm is not None and node_name in cm.data:
                     return cm.data[node_name]
         return None
 
     def _pick_free_partition(
-        self, info: NodeInfo, partitions: List[Partition], chips_wanted: int
+        self,
+        info: NodeInfo,
+        partitions: List[Partition],
+        chips_wanted: int,
+        inv: Optional[NodeInventory] = None,
     ) -> Optional[Partition]:
-        """First partition with enough chips and the fewest pods already
-        attributed to it (deterministic; the reference shuffles UUIDs at
-        gpu_plugins.go:561 — determinism makes hermetic tests exact)."""
+        """Emptiest partition with enough chips: fewest pods already
+        attributed, then lowest live per-chip duty cycle, then least HBM in
+        use — the per-UUID metrics the reference scores with
+        (GetDcgmMetricsForUUIDS, gpu_plugins.go:162-236 feeding :561-756).
+        Deterministic (the reference shuffles UUIDs at :561 — determinism
+        makes hermetic tests exact)."""
         if not partitions:
             return None
         placed = self._placed_slos(info, partitions)
         eligible = [p for p in partitions if len(p.chip_ids) >= chips_wanted]
         if not eligible:
             return None
-        return min(eligible, key=lambda p: (len(placed.get(p.key, {})), p.key))
+
+        def rank(p: Partition):
+            duty, hbm_used, _ = self._partition_load(p, inv)
+            return (len(placed.get(p.key, {})), duty, hbm_used, p.key)
+
+        return min(eligible, key=rank)
+
+    @staticmethod
+    def _partition_load(
+        part: Partition, inv: Optional[NodeInventory]
+    ) -> Tuple[float, int, int]:
+        """(mean duty cycle 0..1, HBM bytes used, HBM bytes total) over the
+        partition's chips, from the agent-published per-chip inventory
+        (registry/inventory.py ChipInfo). No inventory → all zeros, so
+        ranking degrades to pod-count order."""
+        if inv is None or not inv.chips:
+            return 0.0, 0, 0
+        chips = [c for c in inv.chips if c.device_id in part.chip_ids]
+        if not chips:
+            return 0.0, 0, 0
+        duty = sum(c.duty_cycle for c in chips) / len(chips)
+        used = sum(c.hbm_used_bytes for c in chips)
+        total = sum(c.hbm_total_bytes for c in chips)
+        return duty, used, total
 
     def _fill_sharing_limits(
-        self, decision: Decision, topo: SliceTopology, partitions: List[Partition]
+        self,
+        decision: Decision,
+        topo: SliceTopology,
+        partitions: List[Partition],
+        inv: Optional[NodeInventory] = None,
     ) -> None:
         """HBM/duty caps when the host is shared — the MPS-limit analogue
-        (gpu_plugins.go:896-904: 2 partitions → half memory/50%, 4 → quarter/25%)."""
+        (gpu_plugins.go:896-904: 2 partitions → half memory/50%, 4 →
+        quarter/25%). HBM already in use on the assigned partition (per-chip
+        agent inventory) is debited from the cap, so a pod landing next to a
+        resident tenant is budgeted what is actually free, not the nameplate
+        capacity."""
         n = len(partitions)
         if n <= 1:
             return
         per_chip_hbm = int(topo.gen.hbm_gib * (1 << 30))
         chips = len(decision.partition.chip_ids) if decision.partition else 1
-        decision.hbm_limit_bytes = per_chip_hbm * chips
+        limit = per_chip_hbm * chips
+        if decision.partition is not None:
+            _, hbm_used, hbm_total = self._partition_load(decision.partition, inv)
+            if hbm_total > 0:
+                limit = min(limit, hbm_total)
+            limit = max(0, limit - hbm_used)
+        decision.hbm_limit_bytes = limit
         decision.duty_pct = max(1, 100 // n)
 
     _UNFETCHED = object()  # sentinel: caller hasn't consulted the registry

@@ -88,3 +88,51 @@ def test_single_worker_env_stays_local():
     assert not distributed_init_from_env(env={})
     assert not distributed_init_from_env(
         env={"TPU_WORKER_HOSTNAMES": "only-me.svc"})
+
+
+class TestSelfWorkerId:
+    """Shared-ConfigMap gangs: every member reads the same last-written
+    TPU_WORKER_ID scalar, so the id must be self-derived from $HOSTNAME vs
+    the (identical-across-members) address list."""
+
+    ADDRS = [
+        "llama-0.llama.default.svc",
+        "llama-1.llama.default.svc",
+        "llama-2.llama.default.svc",
+    ]
+
+    def test_each_member_derives_its_own_index(self):
+        from k8s_gpu_scheduler_tpu.parallel.distributed import self_worker_id
+
+        for i in range(3):
+            assert self_worker_id(self.ADDRS, {"HOSTNAME": f"llama-{i}"}) == i
+
+    def test_shared_configmap_scalar_is_overridden(self):
+        """All members see the loser-written TPU_WORKER_ID=2; hostname
+        matching must win so ids still come out distinct."""
+        from k8s_gpu_scheduler_tpu.parallel.distributed import (
+            self_worker_id,
+            worker_addresses,
+        )
+
+        ids = set()
+        for i in range(3):
+            env = {
+                "TPU_WORKER_HOSTNAMES": ",".join(self.ADDRS),
+                "TPU_WORKER_ID": "2",  # last writer's id, seen by everyone
+                "HOSTNAME": f"llama-{i}",
+            }
+            addrs = worker_addresses(env)
+            wid = self_worker_id(addrs, env)
+            assert wid is not None
+            ids.add(wid)
+        assert ids == {0, 1, 2}
+
+    def test_no_match_falls_back_to_injected_scalar(self):
+        """Node-address gangs (hostNetwork) can't hostname-match — the
+        per-pod injected scalar still applies."""
+        from k8s_gpu_scheduler_tpu.parallel.distributed import self_worker_id
+
+        assert self_worker_id(["10.0.0.1", "10.0.0.2"],
+                              {"HOSTNAME": "llama-1"}) is None
+        assert self_worker_id(self.ADDRS, {}) is None
