@@ -151,12 +151,18 @@ class PreemptionPlugin(PostFilterPlugin):
         With the TPU plugin available the node's board is carved into its
         current partitions and victims are taken within the single partition
         that frees >= ``need`` chips at minimal cost. ``nominated`` chips
-        (reserved for equal/higher-priority nominees) are debited from each
-        partition's free count — a nomination isn't partition-attributed,
-        so this is conservative per partition; the dry-run Filter is the
-        final arbiter either way. Without the TPU plugin (or topology
-        labels), falls back to node-level greedy over ``node_free``
-        (nomination-adjusted free chips)."""
+        (reserved for equal/higher-priority nominees) aren't
+        partition-attributed, so each candidate partition plans for the
+        nominee too: it consumes raw free space in the OTHER partitions
+        first; the unabsorbed remainder must either fit in this partition
+        beyond ``need`` (evicting further residents here) or be made by
+        evicting lower-priority residents elsewhere on the board. Debiting
+        every partition by the full nominated count instead would make
+        eviction look futile exactly when a sibling partition can host the
+        nominee — the starvation case the nomination adjustment exists
+        for. The dry-run Filter is the final arbiter either way. Without
+        the TPU plugin (or topology labels), falls back to node-level
+        greedy over ``node_free`` (nomination-adjusted free chips)."""
         parts = self._partitions_of(info)
         if not parts:
             return self._greedy_victims(node_free, need, candidates)
@@ -166,6 +172,11 @@ class PreemptionPlugin(PostFilterPlugin):
         # attribution rule shared with Score (tpu.residents_by_partition),
         # ConfigMap fetches memoized inside.
         by_part = self.tpu.residents_by_partition(info, parts)
+        raw_free = {
+            p.key: len(p.chip_ids) - sum(
+                r.spec.tpu_chips() for r in by_part[p.key])
+            for p in parts
+        }
 
         best_cost: Optional[Tuple[int, int]] = None
         best_victims: Optional[List[Pod]] = None
@@ -173,11 +184,16 @@ class PreemptionPlugin(PostFilterPlugin):
             if len(part.chip_ids) < need:
                 continue  # this hole can never fit the preemptor
             occupants = by_part[part.key]
-            free = len(part.chip_ids) - sum(
-                r.spec.tpu_chips() for r in occupants) - nominated
+            free_elsewhere = sum(
+                max(0, f) for k, f in raw_free.items() if k != part.key)
+            # The nominee's chips beyond what raw free space elsewhere
+            # absorbs must coexist with the preemptor here — or be freed
+            # elsewhere below.
+            target = need + max(0, nominated - free_elsewhere)
+            free = raw_free[part.key]
             victims: List[Pod] = []
             for r in sorted(occupants, key=pod_priority):
-                if free >= need:
+                if free >= target:
                     break
                 if r.metadata.uid not in evictable:
                     continue
@@ -185,6 +201,21 @@ class PreemptionPlugin(PostFilterPlugin):
                 free += r.spec.tpu_chips()
             if free < need:
                 continue  # blocked by higher-priority/gang/bare occupants
+            remaining = target - free  # nominee share this partition can't hold
+            if remaining > 0:
+                others = sorted(
+                    (r for p2 in parts if p2.key != part.key
+                     for r in by_part[p2.key]
+                     if r.metadata.uid in evictable),
+                    key=pod_priority,
+                )
+                for r in others:
+                    if remaining <= 0:
+                        break
+                    victims.append(r)
+                    remaining -= r.spec.tpu_chips()
+                if remaining > 0:
+                    continue  # the nominee cannot be placed anywhere
             cost = (len(victims), sum(pod_priority(v) for v in victims))
             if best_cost is None or cost < best_cost:
                 best_cost, best_victims = cost, victims

@@ -912,6 +912,73 @@ class TestPreemption:
         assert [p.metadata.name for p in server.list("Pod")] == []
         assert sched.handle.nominator.node_for(pod.metadata.uid) == "n1"
 
+    def test_partitioned_node_absorbs_nomination_elsewhere(self):
+        """Partition-aware variant: a rival's 4-chip nomination can live in
+        the raw-free partition, so evicting the other partition's residents
+        still helps — debiting every partition by the full nominated count
+        would wrongly conclude eviction is futile."""
+        server = APIServer()
+        sched = make_scheduler(server, registry=FakeRegistry(),
+                               with_preemption=True)
+        cache = sched.handle.cache
+        cache.add_node(mk_node("n1", chips=8,
+                               annotations={ANN_SLICE_CONFIG: "2x2"}))
+        # part-1 holds two low-prio residents; part-0 is raw-free but
+        # notionally reserved by rival Q's nomination.
+        for i in range(2):
+            server.create(ConfigMap(metadata=ObjectMeta(name=f"cm-pl{i}"),
+                                    data={"n1": "part-1/2x2"}))
+            low = mk_pod(f"plow-{i}", chips=2, cm=f"cm-pl{i}", priority=1,
+                         owner="StatefulSet/lows")
+            low.spec.node_name = "n1"
+            server.create(low)
+            cache.add_pod(low)
+        rival = mk_pod("rival-q", chips=4, priority=100)
+        sched.handle.nominator.nominate(rival, "n1")
+
+        preempt = sched.profile.post_filter[0]
+        pod = mk_pod("p", chips=4, priority=100, owner="Job/p")
+        st = preempt.post_filter(CycleState(), pod, {"n1": "insufficient"})
+        assert st.ok, st.message
+        # Both part-1 residents evicted (rival-q itself was never created
+        # on the server — only nominated).
+        assert [p.metadata.name for p in server.list("Pod")] == []
+        assert sched.handle.nominator.node_for(pod.metadata.uid) == "n1"
+
+    def test_cross_partition_victims_make_room_for_nominee_and_preemptor(self):
+        """Live-loop scenario: the scheduler spread one low-prio resident
+        per partition, a rival's nomination holds 4 chips, the preemptor
+        needs 4 — only evicting BOTH residents (one per partition) lets the
+        nominee take one partition and the preemptor the other. Victim
+        selection must plan the nominee's placement, not just this
+        partition's hole."""
+        server = APIServer()
+        server.create(mk_node("n1", chips=8,
+                              annotations={ANN_SLICE_CONFIG: "2x2"}))
+        sched = make_scheduler(server, registry=FakeRegistry(),
+                               with_preemption=True)
+        for i in range(2):
+            server.create(ConfigMap(metadata=ObjectMeta(name=f"cm-x{i}"),
+                                    data={}))
+            server.create(mk_pod(f"xlow-{i}", chips=2, cm=f"cm-x{i}",
+                                 priority=1, owner="StatefulSet/lows"))
+        sched.start()
+        try:
+            assert wait_until(lambda: all(
+                p.spec.node_name for p in server.list("Pod")), timeout=10)
+            rival = mk_pod("rival-q", chips=4, priority=100)
+            sched.handle.nominator.nominate(rival, "n1")
+            server.create(ConfigMap(metadata=ObjectMeta(name="cm-h"), data={}))
+            server.create(mk_pod("high", chips=4, cm="cm-h", priority=100,
+                                 owner="Job/high"))
+            assert wait_until(lambda: any(
+                p.metadata.name == "high" and p.spec.node_name
+                for p in server.list("Pod")), timeout=10)
+            assert sorted(p.metadata.name
+                          for p in server.list("Pod")) == ["high"]
+        finally:
+            sched.stop()
+
     def test_nomination_blocks_equal_priority_rivals(self):
         """After preemption, the freed chips are reserved for the nominee:
         an equal-priority rival's Filter counts them as taken, a
