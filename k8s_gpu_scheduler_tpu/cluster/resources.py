@@ -51,9 +51,16 @@ class Descriptor:
     def create_pod(self, pod: Pod) -> Pod:
         return self.server.create(pod)
 
-    def bind_pod(self, name: str, namespace: str, node_name: str) -> Pod:
+    def bind_pod(self, name: str, namespace: str, node_name: str) -> Optional[Pod]:
         """The Bind verb: set spec.nodeName (upstream kube-scheduler does this
-        through the binding subresource; the plugin never binds directly)."""
+        through the binding subresource; the plugin never binds directly).
+        Servers exposing a direct Binding POST (the REST adapter) take it —
+        one round trip; host_ip is the kubelet's to report there. The
+        in-memory path keeps filling host_ip so tests see a full object."""
+        bind = getattr(self.server, "bind", None)
+        if bind is not None:
+            bind(name, namespace, node_name)
+            return None
         host_ip = node_name
         try:
             node = self.get_node(node_name)
@@ -91,6 +98,13 @@ class Descriptor:
         return self.server.get("ConfigMap", name, namespace)
 
     def update_configmap(self, name: str, namespace: str, data: Dict[str, str]) -> ConfigMap:
+        # Key-append is expressible as a single merge-PATCH; servers that
+        # support it directly (the REST adapter) skip mutate's read half —
+        # one round trip instead of two on the bind hot path.
+        patch = getattr(self.server, "patch_configmap_data", None)
+        if patch is not None:
+            return patch(name, namespace, data)
+
         def fn(cm: ConfigMap) -> None:
             cm.data.update(data)
 

@@ -74,6 +74,16 @@ class SchedulerConfig:
     # Score weight for the TPU plugin (reference uses weight 10100 in
     # deploy/scheduler.yaml:8-24 to drown out default plugins).
     tpu_score_weight: float = 1.0
+    # Filter/Score fan-out: worker threads per cycle (kube-scheduler's
+    # --parallelism, default 16); node counts below parallelize_threshold
+    # run serial (thread handoff costs more than it saves on small pools).
+    parallelism: int = 16
+    parallelize_threshold: int = 32
+    # Feasible-node sampling above min_feasible_to_find nodes
+    # (kube-scheduler's percentageOfNodesToScore): 0 = adaptive
+    # (50 - nodes/125, floor 5), otherwise the literal percentage.
+    percentage_of_nodes_to_score: int = 0
+    min_feasible_to_find: int = 100
     registry: RegistryConfig = field(default_factory=RegistryConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     recommender: RecommenderConfig = field(default_factory=RecommenderConfig)
@@ -86,6 +96,9 @@ class SchedulerConfig:
         cfg.backoff_initial_s = _env("TPU_SCHED_BACKOFF_INITIAL", cfg.backoff_initial_s, float)
         cfg.backoff_max_s = _env("TPU_SCHED_BACKOFF_MAX", cfg.backoff_max_s, float)
         cfg.tpu_score_weight = _env("TPU_SCHED_SCORE_WEIGHT", cfg.tpu_score_weight, float)
+        cfg.parallelism = _env("TPU_SCHED_PARALLELISM", cfg.parallelism, int)
+        cfg.percentage_of_nodes_to_score = _env(
+            "TPU_SCHED_PCT_NODES_TO_SCORE", cfg.percentage_of_nodes_to_score, int)
         cfg.registry.host = _env("TPU_SCHED_REGISTRY_HOST", cfg.registry.host)
         cfg.registry.port = _env("TPU_SCHED_REGISTRY_PORT", cfg.registry.port, int)
         cfg.registry.password = _env("TPU_SCHED_REGISTRY_PASSWORD", cfg.registry.password, str)

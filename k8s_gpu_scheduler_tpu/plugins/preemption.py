@@ -178,47 +178,59 @@ class PreemptionPlugin(PostFilterPlugin):
             for p in parts
         }
 
+        def evict_within(part, amount) -> Optional[List[Pod]]:
+            """Cheapest victims inside ``part`` so its free chips reach
+            ``amount``; None if its occupants can't free that much."""
+            free = raw_free[part.key]
+            out: List[Pod] = []
+            for r in sorted(by_part[part.key], key=pod_priority):
+                if free >= amount:
+                    break
+                if r.metadata.uid not in evictable:
+                    continue
+                out.append(r)
+                free += r.spec.tpu_chips()
+            return out if free >= amount else None
+
+        def cost(victims: List[Pod]) -> Tuple[int, int]:
+            return (len(victims), sum(pod_priority(v) for v in victims))
+
         best_cost: Optional[Tuple[int, int]] = None
         best_victims: Optional[List[Pod]] = None
         for part in parts:
             if len(part.chip_ids) < need:
                 continue  # this hole can never fit the preemptor
-            occupants = by_part[part.key]
-            free_elsewhere = sum(
-                max(0, f) for k, f in raw_free.items() if k != part.key)
-            # The nominee's chips beyond what raw free space elsewhere
-            # absorbs must coexist with the preemptor here — or be freed
-            # elsewhere below.
-            target = need + max(0, nominated - free_elsewhere)
-            free = raw_free[part.key]
-            victims: List[Pod] = []
-            for r in sorted(occupants, key=pod_priority):
-                if free >= target:
-                    break
-                if r.metadata.uid not in evictable:
-                    continue
-                victims.append(r)
-                free += r.spec.tpu_chips()
-            if free < need:
-                continue  # blocked by higher-priority/gang/bare occupants
-            remaining = target - free  # nominee share this partition can't hold
-            if remaining > 0:
-                others = sorted(
-                    (r for p2 in parts if p2.key != part.key
-                     for r in by_part[p2.key]
-                     if r.metadata.uid in evictable),
-                    key=pod_priority,
-                )
-                for r in others:
-                    if remaining <= 0:
-                        break
-                    victims.append(r)
-                    remaining -= r.spec.tpu_chips()
-                if remaining > 0:
-                    continue  # the nominee cannot be placed anywhere
-            cost = (len(victims), sum(pod_priority(v) for v in victims))
-            if best_cost is None or cost < best_cost:
-                best_cost, best_victims = cost, victims
+            # The nominee needs its chips in ONE partition too — planning
+            # it as divisible (summed scattered free chips) would evict
+            # workloads for a placement that can never happen. With
+            # multiple nominees this single-partition requirement is
+            # conservative: it declines some feasible preemptions, never
+            # the reverse. Options per candidate partition:
+            options: List[List[Pod]] = []
+            if nominated <= 0:
+                v = evict_within(part, need)
+                if v is not None:
+                    options.append(v)
+            else:
+                # (a) nominee shares this partition with the preemptor;
+                if len(part.chip_ids) >= need + nominated:
+                    v = evict_within(part, need + nominated)
+                    if v is not None:
+                        options.append(v)
+                # (b) nominee lands whole in another partition q (evicting
+                #     there too if q's occupants allow it).
+                base = evict_within(part, need)
+                if base is not None:
+                    for q in parts:
+                        if q.key == part.key or len(q.chip_ids) < nominated:
+                            continue
+                        vq = evict_within(q, nominated)
+                        if vq is not None:
+                            options.append(base + vq)
+            for victims in options:
+                c = cost(victims)
+                if best_cost is None or c < best_cost:
+                    best_cost, best_victims = c, victims
         return best_victims
 
     def _partitions_of(self, info: NodeInfo):

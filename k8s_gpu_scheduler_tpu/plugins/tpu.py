@@ -28,12 +28,13 @@ parity, re-architected:
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol, Tuple
 
 from ..api.objects import ANN_RESHAPE_STATE, Pod
 from ..api.topology import SliceTopology, TPUGen, chip_count, parse_topology
-from ..registry.inventory import NodeInventory, read_inventory
+from ..registry.inventory import NodeInventory, node_key
 from ..sched.cache import NodeInfo
 from ..sched.framework import (
     CycleState,
@@ -179,6 +180,22 @@ class TPUPlugin(
         self.recommender = recommender
         self.reshaper = reshaper
         self.weight = handle.config.tpu_score_weight
+        # Register the ConfigMap informer NOW (before factory.start()) so
+        # Score's assignment readbacks hit the lister cache instead of one
+        # API-server GET per resident pod per scored node — the reference
+        # reads through its configMapLister for the same reason
+        # (gpu_plugins.go:60-67,893). Writes still go through the
+        # Descriptor (listers are read-only).
+        try:
+            self._cm_lister = handle.factory.informer("ConfigMap")
+        except Exception:  # noqa: BLE001 — factory absent in bare unit tests
+            self._cm_lister = None
+        # node -> (raw registry value, parsed inventory); see _inventory.
+        self._inv_parse_cache: Dict[str, Tuple[str, Optional[NodeInventory]]] = {}
+        # pod uid -> (node, partition key) recorded at Reserve; bridges the
+        # Reserve -> ConfigMap-visible-in-lister window (see reserve()).
+        self._assigned_memo: Dict[str, Tuple[str, str]] = {}
+        self._assign_mu = threading.Lock()
 
     # -- PreFilter ---------------------------------------------------------
     def pre_filter(self, state: CycleState, pod: Pod) -> Status:
@@ -288,6 +305,18 @@ class TPUPlugin(
         if reshape is not None:
             return reshape
         state.write("tpu.reserved", decision)
+        if decision.partition is not None:
+            # Scheduler-local assignment memo: the authoritative record is
+            # the ConfigMap written at PostBind, but between Reserve and
+            # the lister observing that write there's a window where a
+            # concurrent cycle reading only ConfigMaps would not see this
+            # pod's partition and could double-place onto it.
+            # residents_by_partition consults this memo first.
+            with self._assign_mu:
+                self._assigned_memo[pod.metadata.uid] = (
+                    node_name, decision.partition.key)
+                while len(self._assigned_memo) > 4096:
+                    self._assigned_memo.pop(next(iter(self._assigned_memo)))
         return Status.success()
 
     def _maybe_reshape(
@@ -314,6 +343,8 @@ class TPUPlugin(
 
     def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
         state.write("tpu.reserved", None)
+        with self._assign_mu:
+            self._assigned_memo.pop(pod.metadata.uid, None)
 
     # -- PostBind ----------------------------------------------------------
     def post_bind(self, state: CycleState, pod: Pod, node_name: str) -> None:
@@ -509,12 +540,30 @@ class TPUPlugin(
 
     # -- partition / inventory helpers ------------------------------------
     def _inventory(self, node_name: str) -> Optional[NodeInventory]:
+        """Registry read with a parse cache keyed on the RAW value: Score
+        reads every feasible node's inventory every cycle, but the agent
+        republishes each node at most every heartbeat — re-decoding an
+        unchanged JSON blob per (pod × node) was the top cycle cost at 256
+        nodes. The raw string is the cache key, so a republished value is
+        picked up immediately; dict ops are GIL-atomic, so concurrent Score
+        threads at worst parse the same blob twice."""
         if self.registry is None:
             return None
         try:
-            return read_inventory(self.registry, node_name)
+            raw = self.registry.get(node_key(node_name))
         except Exception:  # noqa: BLE001 — registry down = degrade, don't abort
             return None
+        if raw is None:
+            return None
+        cached = self._inv_parse_cache.get(node_name)
+        if cached is not None and cached[0] == raw:
+            return cached[1]
+        try:
+            inv = NodeInventory.from_json(raw)
+        except (ValueError, TypeError, KeyError):
+            inv = None
+        self._inv_parse_cache[node_name] = (raw, inv)
+        return inv
 
     def _partitions(
         self, info: NodeInfo, topo: SliceTopology, inv: Optional[NodeInventory]
@@ -564,10 +613,16 @@ class TPUPlugin(
         fallback = partitions[0].key if partitions else ""
         out: Dict[str, List[Pod]] = {p.key: [] for p in partitions}
         cm_cache: Dict[Tuple[str, str], object] = {}
+        with self._assign_mu:
+            memo = dict(self._assigned_memo)
         for p in info.pods:
             if p.spec.tpu_chips() == 0:
                 continue
-            key = self._assigned_partition(p, info.name, cm_cache)
+            held = memo.get(p.metadata.uid)
+            if held is not None and held[0] == info.name and held[1] in out:
+                key = held[1]
+            else:
+                key = self._assigned_partition(p, info.name, cm_cache)
             if key is None or key not in out:
                 key = fallback
             out.setdefault(key, []).append(p)
@@ -596,17 +651,22 @@ class TPUPlugin(
                 if cm_cache is not None and cache_key in cm_cache:
                     cm = cm_cache[cache_key]
                 else:
-                    try:
-                        cm = self.handle.descriptor.get_configmap(
-                            ref.name, pod.metadata.namespace
-                        )
-                    except Exception:  # noqa: BLE001 — NotFound or API hiccup
-                        cm = None
+                    cm = self._read_configmap(ref.name, pod.metadata.namespace)
                     if cm_cache is not None:
                         cm_cache[cache_key] = cm
                 if cm is not None and node_name in cm.data:
                     return cm.data[node_name]
         return None
+
+    def _read_configmap(self, name: str, namespace: str):
+        """Lister-first ConfigMap read (see __init__); API GET fallback when
+        the informer isn't running (unit tests, bare construction)."""
+        if self._cm_lister is not None and self._cm_lister.has_synced():
+            return self._cm_lister.get(name, namespace)
+        try:
+            return self.handle.descriptor.get_configmap(name, namespace)
+        except Exception:  # noqa: BLE001 — NotFound or API hiccup
+            return None
 
     def _pick_free_partition(
         self,

@@ -28,6 +28,8 @@ class NodeInfo:
     node: Node
     pods: List[Pod] = field(default_factory=list)
     requested_tpu: int = 0
+    # ((accelerator, topology), parsed) memo — see slice_topology().
+    _topo_cache: Optional[tuple] = field(default=None, repr=False, compare=False)
 
     @property
     def name(self) -> str:
@@ -42,13 +44,22 @@ class NodeInfo:
         return self.allocatable_tpu - self.requested_tpu
 
     def slice_topology(self) -> Optional[SliceTopology]:
+        # Parsed once per (node object, label pair): Filter + Score call
+        # this for every (pod × node) and the labels almost never change —
+        # re-parsing the topology string was ~10% of cycle time at 256
+        # nodes. Keyed on the label values, so a relabel invalidates.
         acc, topo = self.node.tpu_accelerator(), self.node.tpu_topology()
         if not acc or not topo:
             return None
+        cached = self._topo_cache
+        if cached is not None and cached[0] == (acc, topo):
+            return cached[1]
         try:
-            return SliceTopology.parse(TPUGen(acc), topo)
+            parsed = SliceTopology.parse(TPUGen(acc), topo)
         except ValueError:
-            return None
+            parsed = None
+        self._topo_cache = ((acc, topo), parsed)
+        return parsed
 
     def shallow_copy(self) -> "NodeInfo":
         return NodeInfo(node=self.node, pods=list(self.pods), requested_tpu=self.requested_tpu)

@@ -67,19 +67,26 @@ class CycleState:
 
     def __init__(self) -> None:
         self._data: Dict[str, Any] = {}
+        # Filter/Score fan out over nodes on a thread pool
+        # (scheduler._parallel_each); plugins write per-node keys
+        # concurrently, and clone() must never iterate a mutating dict.
+        self._mu = threading.Lock()
 
     def write(self, key: str, value: Any) -> None:
-        self._data[key] = value
+        with self._mu:
+            self._data[key] = value
 
     def read(self, key: str, default: Any = None) -> Any:
-        return self._data.get(key, default)
+        with self._mu:
+            return self._data.get(key, default)
 
     def clone(self) -> "CycleState":
         """Shallow copy for speculative re-runs (preemption dry-run Filter):
         the copy sees everything written so far (gang.group, tpu.request)
         but its own writes never leak back into the real cycle."""
         out = CycleState()
-        out._data = dict(self._data)
+        with self._mu:
+            out._data = dict(self._data)
         return out
 
 

@@ -6,277 +6,14 @@ subresource, DELETE) that the ENTIRE scheduler stack — informers, cache,
 TPU plugin, binding — runs unchanged over HTTP, which is the `--in-cluster`
 deployment mode of cmd/scheduler.py.
 """
-import json
-import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
+from tests.fakekube import FakeKube
+
 from k8s_gpu_scheduler_tpu.cluster.kubeapi import KubeAPIServer
 from k8s_gpu_scheduler_tpu.cluster.apiserver import NotFound
-
-
-class FakeKube:
-    """In-memory k8s REST server. Store: kind -> {ns/name: json-dict}."""
-
-    def __init__(self):
-        self.store = {"pods": {}, "nodes": {}, "configmaps": {},
-                      "podgroups": {}, "leases": {}}
-        self.rv = 100
-        self.mu = threading.Lock()
-        self.watchers = []  # (plural, queue-like list, condition)
-        self.binding_posts = []
-        self.gone_on_watch = False  # next watch connect gets a 410 ERROR
-        self.watch_idle_s = 10.0    # idle timeout before closing a watch
-        fake = self
-
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *a):
-                pass
-
-            # -- helpers --------------------------------------------------
-            def _send(self, code, doc):
-                body = json.dumps(doc).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def _route(self):
-                # /api/v1/<plural>, /api/v1/namespaces/<ns>/<plural>[/<name>[/binding]]
-                parts = [p for p in self.path.split("?")[0].split("/") if p]
-                if parts[0] == "apis":
-                    parts = parts[3:]  # strip apis/<group>/<version>
-                else:
-                    parts = parts[2:]  # strip api/v1
-                ns = name = sub = None
-                if parts and parts[0] == "namespaces":
-                    ns, parts = parts[1], parts[2:]
-                plural = parts[0]
-                if len(parts) > 1:
-                    name = parts[1]
-                if len(parts) > 2:
-                    sub = parts[2]
-                return plural, ns, name, sub
-
-            def _body(self):
-                n = int(self.headers.get("Content-Length", 0))
-                return json.loads(self.rfile.read(n)) if n else {}
-
-            # -- verbs ----------------------------------------------------
-            def do_GET(self):
-                plural, ns, name, _ = self._route()
-                if name:
-                    with fake.mu:
-                        obj = fake._get(plural, ns, name)
-                    if obj is None:
-                        return self._send(404, {"reason": "NotFound"})
-                    return self._send(200, obj)
-                if "watch=1" in self.path:
-                    return self._watch(plural)
-                with fake.mu:
-                    items = [o for k, o in sorted(fake.store[plural].items())]
-                    rv = str(fake.rv)
-                return self._send(200, {
-                    "kind": "List", "metadata": {"resourceVersion": rv},
-                    "items": items,
-                })
-
-            def _watch(self, plural):
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
-                # Real apiserver semantics: replay everything newer than the
-                # requested resourceVersion on connect, registered under the
-                # SAME lock — a create landing between the client's LIST and
-                # this connect is replayed, not lost (the round-2 fake
-                # ignored the param, making test_watch_streams_events racy).
-                req_rv = 0
-                for part in self.path.split("?", 1)[-1].split("&"):
-                    if part.startswith("resourceVersion="):
-                        v = part.split("=", 1)[1]
-                        req_rv = int(v) if v.isdigit() else 0
-                cond = threading.Condition()
-                events = []
-                with fake.mu:
-                    if fake.gone_on_watch:
-                        # Simulate etcd compaction: the rv is too old.
-                        fake.gone_on_watch = False
-                        body = json.dumps({
-                            "type": "ERROR",
-                            "object": {"kind": "Status", "code": 410,
-                                       "reason": "Expired",
-                                       "message": "too old resource version"},
-                        }).encode() + b"\n"
-                        self.wfile.write(f"{len(body):x}\r\n".encode()
-                                         + body + b"\r\n")
-                        self.wfile.write(b"0\r\n\r\n")
-                        self.wfile.flush()
-                        return
-                    for obj in sorted(fake.store[plural].values(),
-                                      key=lambda o: int(o["metadata"]
-                                                        ["resourceVersion"])):
-                        if int(obj["metadata"]["resourceVersion"]) > req_rv:
-                            events.append({
-                                "type": "ADDED",
-                                "object": json.loads(json.dumps(obj)),
-                            })
-                    fake.watchers.append((plural, events, cond))
-                try:
-                    while True:
-                        with cond:
-                            while not events:
-                                if not cond.wait(timeout=fake.watch_idle_s):
-                                    return
-                            ev = events.pop(0)
-                        line = json.dumps(ev).encode() + b"\n"
-                        self.wfile.write(f"{len(line):x}\r\n".encode()
-                                         + line + b"\r\n")
-                        self.wfile.flush()
-                except (BrokenPipeError, ConnectionResetError):
-                    return
-
-            def do_POST(self):
-                plural, ns, name, sub = self._route()
-                body = self._body()
-                if sub == "binding":
-                    node = body["target"]["name"]
-                    with fake.mu:
-                        obj = fake._get(plural, ns, name)
-                        if obj is None:
-                            return self._send(404, {})
-                        obj["spec"]["nodeName"] = node
-                        fake._bump(obj)
-                        fake.binding_posts.append((ns, name, node))
-                        fake._emit(plural, "MODIFIED", obj)
-                    return self._send(201, {"kind": "Status", "status": "Success"})
-                with fake.mu:
-                    meta = body.setdefault("metadata", {})
-                    meta.setdefault("namespace", ns or "default")
-                    key = f"{meta['namespace']}/{meta['name']}"
-                    if key in fake.store[plural]:
-                        return self._send(409, {"reason": "AlreadyExists"})
-                    meta.setdefault("uid", f"uid-{meta['name']}")
-                    body.setdefault("spec", {})
-                    body.setdefault("status", {"phase": "Pending"}
-                                    if plural == "pods" else {})
-                    fake._bump(body)
-                    fake.store[plural][key] = body
-                    fake._emit(plural, "ADDED", body)
-                return self._send(201, body)
-
-            def do_PATCH(self):
-                plural, ns, name, _ = self._route()
-                patch = self._body()
-                with fake.mu:
-                    obj = fake._get(plural, ns, name)
-                    if obj is None:
-                        return self._send(404, {})
-                    fake._merge(obj, patch)
-                    fake._bump(obj)
-                    fake._emit(plural, "MODIFIED", obj)
-                return self._send(200, obj)
-
-            def do_PUT(self):
-                plural, ns, name, _ = self._route()
-                body = self._body()
-                with fake.mu:
-                    obj = fake._get(plural, ns, name)
-                    if obj is None:
-                        return self._send(404, {})
-                    want = (body.get("metadata") or {}).get("resourceVersion")
-                    have = obj["metadata"]["resourceVersion"]
-                    if want is not None and str(want) != str(have):
-                        return self._send(409, {
-                            "reason": "Conflict",
-                            "message": f"rv mismatch {want} != {have}"})
-                    key = f"{obj['metadata'].get('namespace', 'default')}/{name}"
-                    if plural == "nodes":
-                        key = f"default/{name}"
-                    body["metadata"]["namespace"] = obj["metadata"].get(
-                        "namespace", "default")
-                    fake._bump(body)
-                    fake.store[plural][key] = body
-                    fake._emit(plural, "MODIFIED", body)
-                return self._send(200, body)
-
-            def do_DELETE(self):
-                plural, ns, name, _ = self._route()
-                with fake.mu:
-                    obj = fake._get(plural, ns, name)
-                    if obj is None:
-                        return self._send(404, {})
-                    key = f"{obj['metadata'].get('namespace', 'default')}/{name}"
-                    if plural == "nodes":
-                        key = f"default/{name}"
-                    fake.store[plural].pop(key, None)
-                    fake._emit(plural, "DELETED", obj)
-                return self._send(200, {"kind": "Status", "status": "Success"})
-
-        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self.thread = threading.Thread(target=self.server.serve_forever,
-                                       daemon=True)
-        self.thread.start()
-
-    @property
-    def url(self):
-        return f"http://127.0.0.1:{self.server.server_port}"
-
-    def _get(self, plural, ns, name):
-        key = f"{ns or 'default'}/{name}"
-        return self.store[plural].get(key)
-
-    def _bump(self, obj):
-        self.rv += 1
-        obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
-
-    def _merge(self, base, patch):
-        """RFC 7386 merge patch: dicts merge recursively, None deletes."""
-        for k, v in patch.items():
-            if v is None:
-                base.pop(k, None)
-            elif isinstance(v, dict) and isinstance(base.get(k), dict):
-                self._merge(base[k], v)
-            else:
-                base[k] = v
-
-    def _emit(self, plural, ev_type, obj):
-        for wplural, events, cond in self.watchers:
-            if wplural == plural:
-                with cond:
-                    events.append({"type": ev_type,
-                                   "object": json.loads(json.dumps(obj))})
-                    cond.notify_all()
-
-    def add_node(self, name, chips=8, labels=None):
-        lab = {"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
-               "cloud.google.com/gke-tpu-topology": "2x4"}
-        lab.update(labels or {})
-        with self.mu:
-            obj = {
-                "kind": "Node",
-                "metadata": {"name": name, "labels": lab, "annotations": {},
-                             "uid": f"uid-{name}"},
-                "status": {
-                    "capacity": {"google.com/tpu": str(chips)},
-                    "allocatable": {"google.com/tpu": str(chips)},
-                    "conditions": [{"type": "Ready", "status": "True"}],
-                    "addresses": [{"type": "InternalIP",
-                                   "address": "10.0.0.1"}],
-                },
-            }
-            self._bump(obj)
-            self.store["nodes"][f"default/{name}"] = obj
-            self._emit("nodes", "ADDED", obj)
-
-    def close(self):
-        self.server.shutdown()
-        self.server.server_close()
 
 
 @pytest.fixture()

@@ -979,6 +979,36 @@ class TestPreemption:
         finally:
             sched.stop()
 
+    def test_no_eviction_when_nominee_cannot_fit_any_partition(self):
+        """The nominee's chips must land in ONE partition. A 4-chip
+        nomination on a board carved into 2-chip partitions can never be
+        placed — victim selection must decline (no destructive deletes for
+        an impossible plan), not count scattered free chips as if the
+        nominee were divisible."""
+        server = APIServer()
+        sched = make_scheduler(server, registry=FakeRegistry(),
+                               with_preemption=True)
+        cache = sched.handle.cache
+        cache.add_node(mk_node("n1", chips=8,
+                               annotations={ANN_SLICE_CONFIG: "1x2"}))
+        # One evictable 1-chip resident per 2-chip partition (1 free each).
+        for i in range(4):
+            server.create(ConfigMap(metadata=ObjectMeta(name=f"cm-s{i}"),
+                                    data={"n1": f"part-{i}/1x2"}))
+            low = mk_pod(f"slow-{i}", chips=1, cm=f"cm-s{i}", priority=1,
+                         owner="StatefulSet/lows")
+            low.spec.node_name = "n1"
+            server.create(low)
+            cache.add_pod(low)
+        rival = mk_pod("rival-q", chips=4, priority=100)
+        sched.handle.nominator.nominate(rival, "n1")
+
+        preempt = sched.profile.post_filter[0]
+        pod = mk_pod("p", chips=2, priority=100, owner="Job/p")
+        st = preempt.post_filter(CycleState(), pod, {"n1": "insufficient"})
+        assert not st.ok
+        assert len(server.list("Pod")) == 4  # nobody was evicted
+
     def test_nomination_blocks_equal_priority_rivals(self):
         """After preemption, the freed chips are reserved for the nominee:
         an equal-priority rival's Filter counts them as taken, a
