@@ -204,6 +204,9 @@ def bench_train_mfu():
 
 
 def main():
+    # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
+    # the bench measures the scheduler as deployed.
+    sys.setswitchinterval(0.001)
     # Discarded warmup: the first churn pays one-time costs (module
     # bytecode, thread-pool spin-up, allocator warm) that would otherwise
     # land in the measured leg's p50.

@@ -37,9 +37,18 @@ class Publisher:
         worker_id: int = 0,
         interval_s: float = 2.0,
         heartbeat_s: float = 30.0,
+        metrics_registry=None,
     ):
+        """``metrics_registry``: optional metrics.exporter.Registry — every
+        scrape also updates the node's own Prometheus gauges (the TPU_SERIES
+        names metrics/client.py queries), so a cluster WITHOUT a third-party
+        exporter still has a live /metrics source per node: agent →
+        (registry AND re-exporter) → Prometheus → scheduler's PromClient
+        fallback. The reference depends on dcgm-exporter existing for this
+        whole leg (prom_metrics.go:63-70)."""
         self.registry = registry
         self.scraper = scraper or Scraper()
+        self.metrics_registry = metrics_registry
         self.node_name = node_name or os.environ.get("NODE_NAME", "")
         if not self.node_name:
             raise ValueError("node name required (arg or NODE_NAME env)")
@@ -68,9 +77,29 @@ class Publisher:
             published_at=time.time(),
         )
 
+    def export_metrics(self, inv: NodeInventory) -> None:
+        """Refresh the re-exporter gauges from one inventory (see __init__).
+        Series names/labels match what metrics/client.py parses back."""
+        if self.metrics_registry is None:
+            return
+        from ..metrics.client import HBM_TOTAL, HBM_USED, MXU_DUTY_CYCLE
+
+        duty = self.metrics_registry.gauge(
+            MXU_DUTY_CYCLE, "Per-chip MXU duty cycle, percent")
+        used = self.metrics_registry.gauge(
+            HBM_USED, "Per-chip HBM bytes in use")
+        total = self.metrics_registry.gauge(
+            HBM_TOTAL, "Per-chip HBM bytes total")
+        for c in inv.chips:
+            labels = {"node": inv.node_name, "device_id": str(c.device_id)}
+            duty.set(round(100.0 * c.duty_cycle, 3), **labels)
+            used.set(float(c.hbm_used_bytes), **labels)
+            total.set(float(c.hbm_total_bytes), **labels)
+
     def publish_once(self, force: bool = False) -> bool:
         """Scrape and publish if changed/stale. Returns True if written."""
         inv = self.build_inventory()
+        self.export_metrics(inv)
         # Change detection must ignore the timestamp (else every tick
         # "changes") — compare the payload with published_at zeroed.
         probe = NodeInventory(**{**inv.__dict__, "published_at": 0.0}).to_json()
@@ -109,13 +138,19 @@ class Publisher:
 
 def main() -> None:  # pragma: no cover — exercised via CLI
     from ..config import SchedulerConfig
+    from ..metrics.exporter import MetricsServer, Registry
     from ..registry.client import Client
 
     logging.basicConfig(level=logging.INFO)
     cfg = SchedulerConfig.from_env()
     registry = Client(cfg.registry.host, cfg.registry.port,
                       password=cfg.registry.password)
-    Publisher(registry)._run()
+    metrics_registry = Registry()
+    port = int(os.environ.get("TPU_AGENT_METRICS_PORT", "8478") or 0)
+    if port > 0:
+        server = MetricsServer(metrics_registry, host="0.0.0.0", port=port).start()
+        log.info("agent re-exporter serving /metrics on :%d", server.port)
+    Publisher(registry, metrics_registry=metrics_registry)._run()
 
 
 if __name__ == "__main__":  # pragma: no cover

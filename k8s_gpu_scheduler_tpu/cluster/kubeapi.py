@@ -393,13 +393,15 @@ class KubeAPIServer:
                     conn.close()
                 except Exception:  # noqa: BLE001
                     pass
-                # Retry ONLY when the request provably never reached the
-                # server: the send itself failed, or a REUSED keep-alive
-                # was found already closed (the server dropped the idle
-                # connection before reading — the classic keep-alive race).
-                # A failure on a FRESH connection after a successful send
-                # means the server may have processed it; don't re-send.
-                if attempt or (sent and not reused):
+                # Retry ONLY when re-sending cannot double-apply: the send
+                # itself failed (an incomplete request is never processed),
+                # or the verb is idempotent and the reused keep-alive died
+                # in the response phase. A non-idempotent verb (POST —
+                # bind, create) that was fully sent may have been applied
+                # even though the connection then broke; re-sending it
+                # could double-apply, so surface the error instead.
+                idempotent = method in ("GET", "HEAD", "PUT", "DELETE")
+                if attempt or (sent and not (reused and idempotent)):
                     raise
         if resp.status >= 400:
             detail = payload.decode(errors="replace")[:300]

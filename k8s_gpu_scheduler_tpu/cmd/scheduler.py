@@ -151,6 +151,14 @@ def demo_cluster(n_pods: int):
 
 
 def main(argv=None) -> int:
+    # Process-level latency tuning (entrypoint, not library: it's an
+    # interpreter-wide knob): the default 5 ms GIL switch interval lets one
+    # thread hold the interpreter while a 1 ms bind waits — a direct
+    # tail-latency tax under churn. kube-scheduler's goroutines preempt
+    # far finer.
+    import sys as _sys
+
+    _sys.setswitchinterval(0.001)
     parser = argparse.ArgumentParser(prog="tpu-scheduler")
     parser.add_argument("--demo", type=int, metavar="N", default=None,
                         help="boot an in-memory demo cluster with N pods")

@@ -172,17 +172,6 @@ class Scheduler:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
-        # The default 5 ms GIL switch interval lets any one thread (cycle,
-        # binder, informer) hold the interpreter for 5 ms while a bind
-        # that needs 1 ms of CPU waits — a direct tail-latency tax under
-        # churn (kube-scheduler's goroutines preempt far finer). 1 ms costs
-        # negligible throughput and measurably cuts e2e p50/p99. It's an
-        # interpreter-wide knob, so the prior value is restored in stop().
-        import sys as _sys
-
-        if _sys.getswitchinterval() > 0.001:
-            self._prev_switch_interval = _sys.getswitchinterval()
-            _sys.setswitchinterval(0.001)
         self.factory.informer("Node")
         self.factory.informer("Pod")
         self.factory.start()
@@ -210,12 +199,6 @@ class Scheduler:
         self._binder.shutdown(wait=True)
         self._cycle_pool.shutdown(wait=True)
         self.factory.stop()
-        prev = getattr(self, "_prev_switch_interval", None)
-        if prev is not None:
-            import sys as _sys
-
-            _sys.setswitchinterval(prev)
-            self._prev_switch_interval = None
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -347,6 +330,14 @@ class Scheduler:
         start = getattr(self, "_scan_offset", 0) % max(len(infos), 1)
         infos = infos[start:] + infos[:start]
         self._scan_offset = (start + 1) % max(len(infos), 1)
+        # The pod's nominated node (preemption) is always scanned FIRST:
+        # sampling may otherwise early-stop before reaching it, and binding
+        # anywhere else wastes the eviction while the nomination keeps the
+        # freed chips fenced (kube-scheduler evaluates the nominated node
+        # ahead of the list for the same reason).
+        nominated = self.handle.nominator.node_for(pod.metadata.uid)
+        if nominated is not None:
+            infos.sort(key=lambda i: i.name != nominated)
 
         feasible: List[NodeInfo] = []
         reasons: Dict[str, str] = {}
@@ -370,23 +361,30 @@ class Scheduler:
         # Parallel: one future per worker SLICE (not per node — 256 futures
         # of submit/set_result overhead cost more than the filters they
         # run), waves so the early-stop check runs between them.
-        workers = max(1, self.config.parallelism)
-        wave = workers * 8
+        wave = max(1, self.config.parallelism) * 8
         for i in range(0, len(infos), wave):
             if len(feasible) >= num_to_find:
                 break
-            chunk = infos[i:i + wave]
-            per = max(1, (len(chunk) + workers - 1) // workers)
-            slices = [chunk[j:j + per] for j in range(0, len(chunk), per)]
-            for results in self._cycle_pool.map(
-                    lambda sl: [check(info) for info in sl], slices):
-                for info, verdict in results:
-                    if verdict is None:
-                        if len(feasible) < num_to_find:
-                            feasible.append(info)
-                    else:
-                        reasons[info.name] = verdict
+            for info, verdict in self._parallel_map(infos[i:i + wave], check):
+                if verdict is None:
+                    if len(feasible) < num_to_find:
+                        feasible.append(info)
+                else:
+                    reasons[info.name] = verdict
         return feasible, reasons
+
+    def _parallel_map(self, items: List, fn) -> List:
+        """Map ``fn`` over ``items`` on the cycle pool, one future per
+        worker slice; results in input order."""
+        workers = max(1, self.config.parallelism)
+        per = max(1, (len(items) + workers - 1) // workers)
+        slices = [items[j:j + per] for j in range(0, len(items), per)]
+        return [
+            r
+            for chunk in self._cycle_pool.map(
+                lambda sl: [fn(x) for x in sl], slices)
+            for r in chunk
+        ]
 
     def _num_feasible_to_find(self, n_nodes: int) -> int:
         """kube-scheduler's numFeasibleNodesToFind: all nodes below the
@@ -414,17 +412,8 @@ class Scheduler:
         parallel = len(feasible) >= self.config.parallelize_threshold
         for pl in self.profile.score:
             if parallel:
-                workers = max(1, self.config.parallelism)
-                per = max(1, (len(feasible) + workers - 1) // workers)
-                slices = [feasible[j:j + per]
-                          for j in range(0, len(feasible), per)]
-                vals = [
-                    v
-                    for chunk in self._cycle_pool.map(
-                        lambda sl: [pl.score(state, pod, i.name) for i in sl],
-                        slices)
-                    for v in chunk
-                ]
+                vals = self._parallel_map(
+                    feasible, lambda info: pl.score(state, pod, info.name))
                 scores = {
                     info.name: (val if st.ok else 0.0)
                     for info, (val, st) in zip(feasible, vals)

@@ -126,6 +126,10 @@ class FakeKube:
                                 "object": json.loads(json.dumps(obj)),
                             })
                     fake.watchers.append((plural, events, cond))
+                # Deregister on ANY exit (idle timeout, client disconnect)
+                # — a dead watcher left in the list would keep receiving a
+                # deep copy of every event forever: unbounded growth and
+                # O(watchers-ever) emit cost after informer reconnects.
                 try:
                     while True:
                         with cond:
@@ -139,6 +143,12 @@ class FakeKube:
                         self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError):
                     return
+                finally:
+                    with fake.mu:
+                        try:
+                            fake.watchers.remove((plural, events, cond))
+                        except ValueError:
+                            pass
 
             def do_POST(self):
                 plural, ns, name, sub = self._route()

@@ -224,3 +224,190 @@ class TestMetricsLogger:
         assert proc.returncode == 0, proc.stderr
         lines = open(out).read().strip().split("\n")
         assert len(lines) == 3
+
+
+class FakeDevicePlugin:
+    """A fake GKE tpu-device-plugin metrics endpoint: serves Prometheus
+    text with the device-plugin naming (duty_cycle/memory_used/memory_total,
+    accelerator_id label)."""
+
+    def __init__(self, per_chip):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.per_chip = per_chip
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                lines = ["# HELP duty_cycle TPU duty cycle percent",
+                         "# TYPE duty_cycle gauge"]
+                for idx, m in fake.per_chip.items():
+                    lab = f'accelerator_id="4804277629165885214-{idx}",make="cloud-tpu"'
+                    lines.append(f'duty_cycle{{{lab}}} {m["duty"]}')
+                    lines.append(f'memory_used{{{lab}}} {m["used"]}')
+                    lines.append(f'memory_total{{{lab}}} {m["total"]}')
+                body = "\n".join(lines).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_port}/metrics"
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+import threading  # noqa: E402 — used by FakeDevicePlugin
+
+
+class TestDevicePluginSource:
+    def test_parses_gke_convention(self):
+        from k8s_gpu_scheduler_tpu.agent.deviceplugin import DevicePluginSource
+
+        gib = 1 << 30
+        dp = FakeDevicePlugin({
+            0: {"duty": 87.5, "used": 12 * gib, "total": 16 * gib},
+            3: {"duty": 2.0, "used": 1 * gib, "total": 16 * gib},
+        })
+        try:
+            metrics = DevicePluginSource(dp.url).read()
+        finally:
+            dp.close()
+        assert metrics[0].duty_cycle == pytest.approx(0.875)
+        assert metrics[0].hbm_used_bytes == 12 * gib
+        assert metrics[3].duty_cycle == pytest.approx(0.02)
+        assert metrics[3].hbm_total_bytes == 16 * gib
+
+    def test_parses_own_reexported_convention(self):
+        """Round-trip: the agent's OWN exporter output parses back (same
+        synonyms table), proving the two conventions interoperate."""
+        from k8s_gpu_scheduler_tpu.agent.deviceplugin import (
+            DevicePluginSource, parse_prom_text,
+        )
+        from k8s_gpu_scheduler_tpu.metrics.exporter import Registry
+
+        reg = Registry()
+        reg.gauge("tpu_duty_cycle_percent", "").set(
+            42.0, node="n1", device_id="2")
+        reg.gauge("tpu_hbm_memory_usage_bytes", "").set(
+            5.0, node="n1", device_id="2")
+        samples = list(parse_prom_text(reg.expose()))
+        assert ("tpu_duty_cycle_percent",
+                {"node": "n1", "device_id": "2"}, 42.0) in samples
+
+        class Src(DevicePluginSource):
+            def fetch_text(self):
+                return reg.expose()
+
+        metrics = Src("unused").read()
+        assert metrics[2].duty_cycle == pytest.approx(0.42)
+        assert metrics[2].hbm_used_bytes == 5
+
+    def test_unreachable_endpoint_degrades_to_empty(self):
+        from k8s_gpu_scheduler_tpu.agent.deviceplugin import DevicePluginSource
+
+        assert DevicePluginSource("http://127.0.0.1:1/metrics").read() == {}
+
+
+class TestLiveUtilizationE2E:
+    def test_device_plugin_duty_reaches_scheduler_score(self, tmp_path):
+        """VERDICT r3 #4 'done' criterion: duty cycles originate from a fake
+        device-plugin HTTP endpoint (the prober's own values are ZERO, as on
+        real hardware), flow agent -> registry -> plugin, and Score reflects
+        them."""
+        from k8s_gpu_scheduler_tpu.agent.deviceplugin import DevicePluginSource
+        from k8s_gpu_scheduler_tpu.cluster import APIServer
+        from k8s_gpu_scheduler_tpu.config import SchedulerConfig
+        from k8s_gpu_scheduler_tpu.plugins import TPUPlugin
+        from k8s_gpu_scheduler_tpu.sched import CycleState, Profile, Scheduler
+        from tests.test_plugins import mk_node, mk_pod
+
+        gib = 1 << 30
+        reg = MemRegistry()
+        endpoints = {}
+        try:
+            for name, duty_pct in [("busy", 90.0), ("idle", 10.0)]:
+                # Prober reports zeros (the real /dev/accel* seam has no
+                # utilization); the device-plugin endpoint has the truth.
+                fake = write_fake(tmp_path, [
+                    {"device_id": i, "duty_cycle": 0.0, "hbm_used": 0,
+                     "hbm_total": 0} for i in range(8)
+                ])
+                dp = FakeDevicePlugin({
+                    i: {"duty": duty_pct, "used": 2 * gib, "total": 16 * gib}
+                    for i in range(8)
+                })
+                endpoints[name] = dp
+                Publisher(
+                    reg,
+                    scraper=Scraper(binary=PROBE_BIN, fake_file=fake,
+                                    device_plugin=DevicePluginSource(dp.url)),
+                    node_name=name, accelerator="tpu-v5-lite-podslice",
+                    topology="2x4",
+                ).publish_once()
+
+            inv = read_inventory(reg, "busy")
+            assert inv.utilization == pytest.approx(0.9)
+            assert inv.chips[0].hbm_total_bytes == 16 * gib
+
+            sched = Scheduler(APIServer(), profile=Profile(),
+                              config=SchedulerConfig())
+            plugin = TPUPlugin(sched.handle, registry=reg)
+            for n in ("busy", "idle"):
+                sched.cache.add_node(mk_node(n))
+            state = CycleState()
+            pod = mk_pod("p", chips=1)
+            plugin.pre_filter(state, pod)
+            for n in ("busy", "idle"):
+                assert plugin.filter(state, pod, sched.cache.snapshot()[n]).ok
+            s_busy, _ = plugin.score(state, pod, "busy")
+            s_idle, _ = plugin.score(state, pod, "idle")
+            assert s_idle == pytest.approx(90.0)
+            assert s_busy == pytest.approx(10.0)
+        finally:
+            for dp in endpoints.values():
+                dp.close()
+
+    def test_agent_reexports_series_prometheus_fallback_reads(self, tmp_path):
+        """The agent's own /metrics re-exporter serves EXACTLY the series
+        names metrics/client.py queries, with the node/device_id labels its
+        parser extracts — so a Prometheus scraping only our agents feeds
+        the scheduler's fallback with no third-party exporter."""
+        from k8s_gpu_scheduler_tpu.agent.deviceplugin import parse_prom_text
+        from k8s_gpu_scheduler_tpu.metrics.client import (
+            HBM_TOTAL, HBM_USED, MXU_DUTY_CYCLE,
+        )
+        from k8s_gpu_scheduler_tpu.metrics.exporter import MetricsServer, Registry
+        import urllib.request
+
+        fake = write_fake(tmp_path, [
+            {"device_id": i, "duty_cycle": 0.5, "hbm_used": 1,
+             "hbm_total": 2} for i in range(4)
+        ])
+        metrics_registry = Registry()
+        pub = Publisher(
+            MemRegistry(), scraper=Scraper(binary=PROBE_BIN, fake_file=fake),
+            node_name="n1", metrics_registry=metrics_registry,
+        )
+        pub.publish_once()
+        server = MetricsServer(metrics_registry).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/metrics", timeout=5) as r:
+                text = r.read().decode()
+        finally:
+            server.stop()
+        samples = {(n, l.get("node"), l.get("device_id")): v
+                   for n, l, v in parse_prom_text(text)}
+        for i in range(4):
+            assert samples[(MXU_DUTY_CYCLE, "n1", str(i))] == 50.0
+            assert samples[(HBM_USED, "n1", str(i))] == 1.0
+            assert samples[(HBM_TOTAL, "n1", str(i))] == 2.0
