@@ -203,6 +203,41 @@ def bench_train_mfu():
     }
 
 
+def bench_serving():
+    """BASELINE config 5's serving side: continuous-batching QPS on the
+    real chip (skipped on CPU — the interpreted decode would dominate the
+    line with noise)."""
+    import numpy as np
+
+    import jax
+
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+    if jax.devices()[0].platform == "cpu":
+        return {}
+    cfg = LlamaConfig(
+        vocab=32000, d_model=1024, n_layers=4, n_heads=16, n_kv_heads=16,
+        d_ff=4096, max_seq=1024, remat=False,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    eng = ContinuousBatcher(params, cfg, n_slots=8, max_len=512, chunk=16,
+                            prefill_bucket=128)
+    eng.submit(rng.integers(0, cfg.vocab, 64), max_new=17)  # compile both
+    eng.run()
+    n_req, max_new = 32, 64
+    t0 = time.perf_counter()
+    for _ in range(n_req):
+        eng.submit(rng.integers(0, cfg.vocab, 64), max_new=max_new)
+    eng.run()
+    dt = time.perf_counter() - t0
+    return {
+        "serve_qps": round(n_req / dt, 2),
+        "serve_decode_tok_s": round(n_req * max_new / dt, 0),
+    }
+
+
 def main():
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
     # the bench measures the scheduler as deployed.
@@ -230,13 +265,17 @@ def main():
         train = bench_train_mfu()
     except Exception as e:  # noqa: BLE001 — accelerator part must not kill the line
         train = {"error": str(e)[:200]}
+    try:
+        serve = bench_serving()
+    except Exception as e:  # noqa: BLE001
+        serve = {"serve_error": str(e)[:200]}
     p50 = churn["p50_ms"] or 1e-6
     print(json.dumps({
         "metric": "p50_schedule_latency_64pod_churn",
         "value": churn["p50_ms"],
         "unit": "ms",
         "vs_baseline": round(BASELINE_P50_MS / p50, 2),
-        "extra": {**churn, **churn_rest, **churn_256, **train},
+        "extra": {**churn, **churn_rest, **churn_256, **train, **serve},
     }))
 
 

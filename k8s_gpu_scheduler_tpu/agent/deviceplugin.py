@@ -108,6 +108,7 @@ class DevicePluginSource:
             log.debug("device-plugin endpoint %s unreachable: %s", self.url, e)
             return {}
         out: Dict[int, ChipMetrics] = {}
+        has_duty = set()
         for name, labels, value in parse_prom_text(text):
             idx = device_index(labels)
             if idx is None:
@@ -116,12 +117,20 @@ class DevicePluginSource:
             if name in DUTY_NAMES:
                 # Both conventions report percent 0..100.
                 cm.duty_cycle = max(0.0, min(1.0, value / 100.0))
+                has_duty.add(idx)
             elif name in HBM_USED_NAMES:
                 cm.hbm_used_bytes = int(value)
             elif name in HBM_TOTAL_NAMES:
                 cm.hbm_total_bytes = int(value)
             elif name in TENSORCORE_NAMES:
                 cm.tensorcore_util = max(0.0, min(1.0, value / 100.0))
+        # An endpoint exporting only tensorcore_utilization (some libtpu
+        # exporter versions) must still drive scoring — without the
+        # fallback such nodes publish duty 0 and score as idle, the exact
+        # defect this module exists to fix.
+        for idx, cm in out.items():
+            if idx not in has_duty and cm.tensorcore_util > 0.0:
+                cm.duty_cycle = cm.tensorcore_util
         return out
 
 

@@ -148,8 +148,15 @@ def main() -> None:  # pragma: no cover — exercised via CLI
     metrics_registry = Registry()
     port = int(os.environ.get("TPU_AGENT_METRICS_PORT", "8478") or 0)
     if port > 0:
-        server = MetricsServer(metrics_registry, host="0.0.0.0", port=port).start()
-        log.info("agent re-exporter serving /metrics on :%d", server.port)
+        try:
+            server = MetricsServer(
+                metrics_registry, host="0.0.0.0", port=port).start()
+            log.info("agent re-exporter serving /metrics on :%d", server.port)
+        except OSError as e:
+            # hostNetwork means the port is shared with the whole node —
+            # a taken port must not take down inventory publishing
+            # (observability never breaks the agent's primary job).
+            log.warning("re-exporter disabled (port %d): %s", port, e)
     Publisher(registry, metrics_registry=metrics_registry)._run()
 
 

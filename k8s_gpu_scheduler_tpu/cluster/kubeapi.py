@@ -396,11 +396,13 @@ class KubeAPIServer:
                 # Retry ONLY when re-sending cannot double-apply: the send
                 # itself failed (an incomplete request is never processed),
                 # or the verb is idempotent and the reused keep-alive died
-                # in the response phase. A non-idempotent verb (POST —
-                # bind, create) that was fully sent may have been applied
-                # even though the connection then broke; re-sending it
-                # could double-apply, so surface the error instead.
-                idempotent = method in ("GET", "HEAD", "PUT", "DELETE")
+                # in the response phase. PATCH counts as idempotent here:
+                # every merge-patch this client issues sets absolute values
+                # (no increments), so replaying one is a no-op. POST (bind,
+                # create) that was fully sent may have been applied even
+                # though the connection then broke; re-sending it could
+                # double-apply, so surface the error instead.
+                idempotent = method in ("GET", "HEAD", "PUT", "PATCH", "DELETE")
                 if attempt or (sent and not (reused and idempotent)):
                     raise
         if resp.status >= 400:

@@ -265,9 +265,6 @@ class FakeDevicePlugin:
         self.server.server_close()
 
 
-import threading  # noqa: E402 — used by FakeDevicePlugin
-
-
 class TestDevicePluginSource:
     def test_parses_gke_convention(self):
         from k8s_gpu_scheduler_tpu.agent.deviceplugin import DevicePluginSource
