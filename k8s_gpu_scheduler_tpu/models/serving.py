@@ -80,6 +80,12 @@ def forward_with_cache(
     (logits [B, t, vocab], updated cache). t is static (prefill: prompt
     length; decode: 1); the position is traced, so both programs compile
     once and serve any request length ≤ max_seq."""
+    if cfg.n_experts > 1:
+        # The serving blocks below call the dense SwiGLU; MoE params are
+        # expert-stacked and would fail deep in a dot_general otherwise.
+        raise NotImplementedError(
+            "MoE serving is not implemented — KV-cache decode paths "
+            "(generate/ContinuousBatcher) support dense configs only")
     B, t = tokens.shape
     pos = cache["len"]
     angles = jax.lax.dynamic_slice_in_dim(
@@ -285,6 +291,9 @@ class ContinuousBatcher:
     def __init__(self, params, cfg: LlamaConfig, n_slots: int = 8,
                  max_len: Optional[int] = None, chunk: int = 8,
                  prefill_bucket: int = 128, mesh: Optional[Mesh] = None):
+        if cfg.n_experts > 1:
+            raise NotImplementedError(
+                "MoE serving is not implemented (dense configs only)")
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots

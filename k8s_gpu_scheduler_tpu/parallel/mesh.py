@@ -40,14 +40,20 @@ class MeshSpec:
         return int(np.prod(self.shape)) if self.axes else 1
 
     @staticmethod
-    def for_devices(n: int, fsdp: int = 1, sp: int = 1, tp: int = 1) -> "MeshSpec":
-        """Default 4-axis layout for n devices: fill fsdp/sp/tp as asked,
-        rest is dp. All four axis names always exist (size 1 where unused) so
-        one set of PartitionSpecs works at any scale."""
-        denom = fsdp * sp * tp
+    def for_devices(n: int, fsdp: int = 1, sp: int = 1, tp: int = 1,
+                    ep: int = 1) -> "MeshSpec":
+        """Default 5-axis layout for n devices: fill fsdp/sp/ep/tp as asked,
+        rest is dp. All five axis names always exist (size 1 where unused) so
+        one set of PartitionSpecs works at any scale. ep (expert parallelism,
+        ops/moe.py) sits between sp and tp: expert all_to_alls are bulkier
+        than tp all-reduces but rarer, so tp keeps the innermost (fastest
+        ICI) ring."""
+        denom = fsdp * sp * ep * tp
         if n % denom:
-            raise ValueError(f"{n} devices not divisible by fsdp*sp*tp={denom}")
-        return MeshSpec({"dp": n // denom, "fsdp": fsdp, "sp": sp, "tp": tp})
+            raise ValueError(
+                f"{n} devices not divisible by fsdp*sp*ep*tp={denom}")
+        return MeshSpec({"dp": n // denom, "fsdp": fsdp, "sp": sp, "ep": ep,
+                         "tp": tp})
 
 
 def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:

@@ -34,6 +34,9 @@ DEFAULT_RULES: Dict[str, object] = {
     "norm": None,
     "batch": ("dp", "fsdp"),
     "seq": "sp",
+    # MoE (ops/moe.py): the stacked expert dim shards over ep — GSPMD
+    # turns the dispatch/combine einsums into all_to_alls over that axis.
+    "expert": "ep",
 }
 
 

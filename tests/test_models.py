@@ -266,3 +266,99 @@ class TestContinuousBatching:
             ref = generate(params, p[None, :], self.cfg, max_new=budget,
                            max_len=32)
             assert done[rid] == [int(t) for t in ref[0]], rid
+
+
+class TestMoE:
+    """Mixture-of-Experts FFN + expert parallelism (ops/moe.py, the ep mesh
+    axis) — the one parallelism-checklist entry (EP) absent through r3."""
+
+    def _cfg(self, experts=4, top_k=2, cf=2.0):
+        return LlamaConfig(
+            vocab=128, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+            d_ff=64, max_seq=64, dtype=jnp.float32, remat=False,
+            n_experts=experts, moe_top_k=top_k, moe_capacity_factor=cf,
+        )
+
+    def test_identical_experts_match_dense(self):
+        """With every expert's weights EQUAL and ample capacity, routing is
+        irrelevant: MoE output must equal the dense SwiGLU (gates sum to 1
+        after renormalization)."""
+        from k8s_gpu_scheduler_tpu.ops.layers import swiglu
+        from k8s_gpu_scheduler_tpu.ops.moe import moe_ffn
+
+        key = jax.random.PRNGKey(0)
+        D, F, E = 32, 64, 4
+        x = jax.random.normal(key, (2, 8, D), jnp.float32)
+        wg = jax.random.normal(jax.random.fold_in(key, 1), (D, F)) * 0.1
+        wu = jax.random.normal(jax.random.fold_in(key, 2), (D, F)) * 0.1
+        wd = jax.random.normal(jax.random.fold_in(key, 3), (F, D)) * 0.1
+        router = jax.random.normal(jax.random.fold_in(key, 4), (D, E)) * 0.1
+        stack = lambda w: jnp.broadcast_to(w, (E,) + w.shape)
+        out, aux = moe_ffn(x, router, stack(wg), stack(wu), stack(wd),
+                           top_k=2, capacity_factor=8.0)
+        assert float(aux) > 0.0
+        ref = swiglu(x, wg, wu, wd)
+        assert float(jnp.abs(out - ref).max()) < 1e-4
+
+    def test_capacity_drop_passes_residual(self):
+        """Capacity 1 with all tokens routed to one expert: only the first
+        token per batch row gets computed; the rest emit zeros (the model's
+        residual add then passes them through)."""
+        from k8s_gpu_scheduler_tpu.ops.moe import moe_ffn
+
+        D, F, E = 8, 16, 2
+        x = jnp.ones((1, 4, D), jnp.float32)
+        # Router forces expert 0 for every token.
+        router = jnp.zeros((D, E)).at[:, 0].set(10.0)
+        wg = jnp.ones((E, D, F)) * 0.1
+        wu = jnp.ones((E, D, F)) * 0.1
+        wd = jnp.ones((E, F, D)) * 0.1
+        out, _ = moe_ffn(x, router, wg, wu, wd, top_k=1, capacity_factor=0.25)
+        assert float(jnp.abs(out[0, 0]).max()) > 0           # served
+        assert float(jnp.abs(out[0, 1:]).max()) == 0.0       # dropped
+
+    def test_moe_train_step_decreases_loss(self):
+        import optax
+
+        from k8s_gpu_scheduler_tpu.models import (
+            init_params, make_train_step,
+        )
+
+        cfg = self._cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab)
+        batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+        opt = optax.adamw(1e-2)
+        state = opt.init(params)
+        step = make_train_step(cfg, None, opt)
+        params, state, first = step(params, state, batch)
+        for _ in range(5):
+            params, state, loss = step(params, state, batch)
+        assert float(loss) < float(first)
+
+    def test_ep_sharded_loss_matches_unsharded(self):
+        """Full train-step parity on an 8-device mesh with a real ep axis
+        ({fsdp:2, ep:2, tp:2}): GSPMD's all_to_all dispatch must be
+        numerically identical to the single-device path."""
+        from k8s_gpu_scheduler_tpu.models import init_params, loss_fn
+        from k8s_gpu_scheduler_tpu.parallel import MeshSpec, make_mesh
+
+        cfg = self._cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab)
+        batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+        ref = float(loss_fn(params, batch, cfg, None))
+        mesh = make_mesh(MeshSpec.for_devices(8, fsdp=2, ep=2, tp=2))
+        got = float(loss_fn(params, batch, cfg, mesh))
+        assert abs(got - ref) < 1e-4, (got, ref)
+
+    def test_balance_loss_uniform_is_one(self):
+        from k8s_gpu_scheduler_tpu.ops.moe import load_balancing_loss
+
+        D, E = 16, 4
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, D))
+        router = jnp.zeros((D, E))  # uniform probs
+        val = float(load_balancing_loss(x, router, top_k=1))
+        # Uniform probs: mean_prob = 1/E; top-1 ties broken deterministically
+        # but frac sums to 1 → loss = E * (1/E) = 1.
+        assert val == pytest.approx(1.0, abs=1e-5)
