@@ -21,7 +21,6 @@ axis (EP) VERDICT.md r3 left as a stretch item.
 """
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

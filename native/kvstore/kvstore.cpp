@@ -13,7 +13,12 @@
 //
 // plus append-only persistence (--appendonly FILE replays a RESP command log
 // at startup — parity with the reference's Redis AOF-on-PV durability,
-// SURVEY.md §5 "Checkpoint / resume").
+// SURVEY.md §5 "Checkpoint / resume"). AOF hygiene mirrors Redis:
+// --appendfsync always|everysec|no (default everysec — at most one second
+// of acknowledged writes lost on power cut), the log is COMPACTED into a
+// one-SET-per-live-key snapshot at startup (heartbeat rewrites otherwise
+// grow it without bound and every restart replays all of it), and it
+// auto-rewrites whenever it doubles past the last compaction.
 //
 // Concurrency: thread-per-connection; one mutex over the 16-db store. The
 // write rate is node-agent inventory publishes (one key per node every few
@@ -22,11 +27,14 @@
 // Build: make (g++ -std=c++17 -O2 -pthread). No dependencies.
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <signal.h>
 #include <sys/socket.h>
 #include <unistd.h>
+
+#include <chrono>
 
 #include <algorithm>
 #include <array>
@@ -46,11 +54,18 @@ namespace {
 
 constexpr int kNumDbs = 16;
 
+enum class Fsync { kAlways, kEverysec, kNo };
+
 struct Store {
   std::mutex mu;
   std::array<std::unordered_map<std::string, std::string>, kNumDbs> dbs;
-  std::ofstream aof;
+  int aof_fd = -1;
   bool aof_enabled = false;
+  std::string aof_path;
+  Fsync fsync_policy = Fsync::kEverysec;
+  bool aof_dirty = false;        // bytes written since last fsync
+  size_t aof_size = 0;           // bytes in the log now
+  size_t aof_base_size = 0;      // bytes right after the last rewrite
 };
 
 Store g_store;
@@ -117,13 +132,110 @@ bool glob_match(const char* pat, const char* str) {
 
 std::mutex g_aof_mu;
 
+std::string aof_frame(int db, const std::vector<std::string>& argv) {
+  // Each record: db index, then the command, RESP-framed.
+  std::string out = "#" + std::to_string(db) + "\r\n" + array_hdr(argv.size());
+  for (const auto& a : argv) out += bulk(a);
+  return out;
+}
+
+bool write_all(int fd, const std::string& buf) {
+  size_t off = 0;
+  while (off < buf.size()) {
+    ssize_t n = write(fd, buf.data() + off, buf.size() - off);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+// Compacts the log to one SET per live key — the state the replay would
+// rebuild, minus every superseded heartbeat write. Caller must hold
+// g_store.mu (reads the dbs) and g_aof_mu (swaps the fd); at startup,
+// before any client thread exists, neither is needed.
+bool aof_rewrite_locked() {
+  const std::string tmp = g_store.aof_path + ".rewrite";
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return false;
+  std::string buf;
+  for (int db = 0; db < kNumDbs; db++) {
+    for (const auto& kv : g_store.dbs[db]) {
+      buf += aof_frame(db, {"SET", kv.first, kv.second});
+      if (buf.size() > (1u << 20)) {
+        if (!write_all(fd, buf)) { close(fd); return false; }
+        buf.clear();
+      }
+    }
+  }
+  size_t total_hint = 0;
+  if (!buf.empty() && !write_all(fd, buf)) { close(fd); return false; }
+  // fsync BEFORE rename: the rename must never expose a file whose data
+  // is still only in the page cache.
+  if (fsync(fd) != 0) { close(fd); return false; }
+  off_t sz = lseek(fd, 0, SEEK_END);
+  total_hint = sz > 0 ? static_cast<size_t>(sz) : 0;
+  close(fd);
+  if (rename(tmp.c_str(), g_store.aof_path.c_str()) != 0) return false;
+  if (g_store.aof_fd >= 0) close(g_store.aof_fd);
+  g_store.aof_fd = open(g_store.aof_path.c_str(),
+                        O_WRONLY | O_APPEND, 0644);
+  g_store.aof_size = g_store.aof_base_size = total_hint;
+  g_store.aof_dirty = false;
+  return g_store.aof_fd >= 0;
+}
+
 void aof_record(int db, const std::vector<std::string>& argv) {
   if (!g_store.aof_enabled) return;
   std::lock_guard<std::mutex> lk(g_aof_mu);
-  // Each record: db index, then the command, RESP-framed.
-  g_store.aof << "#" << db << "\r\n" << array_hdr(argv.size());
-  for (const auto& a : argv) g_store.aof << bulk(a);
-  g_store.aof.flush();
+  const std::string rec = aof_frame(db, argv);
+  if (!write_all(g_store.aof_fd, rec)) {
+    // FAIL-STOP: a partial frame (ENOSPC/EIO) is a torn record; appending
+    // more after it would bury every later write behind the point where
+    // replay stops. Disable persistence loudly instead — replay then
+    // loses only this one record.
+    std::cerr << "kvstored: AOF append failed (" << std::strerror(errno)
+              << "); persistence DISABLED\n";
+    g_store.aof_enabled = false;
+    return;
+  }
+  g_store.aof_size += rec.size();
+  if (g_store.fsync_policy == Fsync::kAlways) {
+    fsync(g_store.aof_fd);
+  } else {
+    g_store.aof_dirty = true;
+  }
+  // Auto-rewrite once the log doubles past the last compaction (Redis's
+  // auto-aof-rewrite-percentage 100) with a 1 MiB floor; the caller
+  // already holds g_store.mu (every aof_record call site is inside
+  // execute()'s store critical section), so the rewrite may read the dbs.
+  // The rewrite is SYNCHRONOUS under both locks — deliberate: the store
+  // is node-inventory scale (KBs per node), so the stall is bounded by a
+  // few MBs of sequential IO; Redis forks for this because its stores are
+  // GBs. Revisit if the registry ever holds more than inventory.
+  if (g_store.aof_size > (1u << 20) &&
+      g_store.aof_size > 2 * std::max<size_t>(g_store.aof_base_size, 1)) {
+    if (!aof_rewrite_locked()) {
+      std::cerr << "kvstored: AOF auto-rewrite failed; persistence "
+                   "DISABLED\n";
+      g_store.aof_enabled = false;
+    }
+  }
+}
+
+// everysec fsync pump — at most one second of acknowledged writes is lost
+// on power cut (Redis's appendfsync everysec contract).
+void fsync_loop() {
+  for (;;) {
+    std::this_thread::sleep_for(std::chrono::seconds(1));
+    std::lock_guard<std::mutex> lk(g_aof_mu);
+    if (g_store.aof_dirty && g_store.aof_fd >= 0) {
+      fsync(g_store.aof_fd);
+      g_store.aof_dirty = false;
+    }
+  }
 }
 
 // --- command dispatch -------------------------------------------------------
@@ -349,9 +461,12 @@ void serve_client(int fd) {
 
 // --- AOF replay -------------------------------------------------------------
 
-void replay_aof(const std::string& path) {
+// Returns true when the whole file parsed (or it doesn't exist); false
+// means a torn/corrupt tail was skipped — main() preserves the original
+// bytes for manual recovery before compacting over them.
+bool replay_aof(const std::string& path) {
   std::ifstream in(path, std::ios::binary);
-  if (!in) return;
+  if (!in) return true;
   std::string content((std::istreambuf_iterator<char>(in)),
                       std::istreambuf_iterator<char>());
   size_t pos = 0;
@@ -410,6 +525,7 @@ void replay_aof(const std::string& path) {
     if (!ok) break;
     execute(sess, argv, /*record=*/false);
   }
+  return pos >= content.size();
 }
 
 }  // namespace
@@ -427,17 +543,47 @@ int main(int argc, char** argv) {
     else if (a == "--bind" && i + 1 < argc) bind_addr = argv[++i];
     else if (a == "--requirepass" && i + 1 < argc) g_password = argv[++i];
     else if (a == "--appendonly" && i + 1 < argc) aof_path = argv[++i];
+    else if (a == "--appendfsync" && i + 1 < argc) {
+      std::string p = argv[++i];
+      if (p == "always") g_store.fsync_policy = Fsync::kAlways;
+      else if (p == "everysec") g_store.fsync_policy = Fsync::kEverysec;
+      else if (p == "no") g_store.fsync_policy = Fsync::kNo;
+      else {
+        std::cerr << "bad --appendfsync (always|everysec|no)\n";
+        return 1;
+      }
+    }
     else if (a == "--help") {
       std::cout << "kvstored [--port N] [--bind ADDR] [--requirepass PW] "
-                   "[--appendonly FILE]\n";
+                   "[--appendonly FILE] [--appendfsync always|everysec|no]\n";
       return 0;
     }
   }
 
   if (!aof_path.empty()) {
-    replay_aof(aof_path);
-    g_store.aof.open(aof_path, std::ios::app | std::ios::binary);
-    g_store.aof_enabled = g_store.aof.good();
+    if (!replay_aof(aof_path)) {
+      // Torn/corrupt tail: the compaction below would destroy the bytes
+      // after the tear — keep them for manual recovery first.
+      const std::string save = aof_path + ".corrupt";
+      std::cerr << "kvstored: AOF has a corrupt tail; preserving original "
+                   "as " << save << "\n";
+      std::ifstream src(aof_path, std::ios::binary);
+      std::ofstream dst(save, std::ios::binary | std::ios::trunc);
+      dst << src.rdbuf();
+    }
+    // Startup compaction: replace the replayed history with a snapshot of
+    // the state it produced (single-threaded here, no locks needed). The
+    // pre-rewrite log is a heartbeat-per-node append stream — unbounded
+    // growth, fully replayed on every restart without this.
+    g_store.aof_path = aof_path;
+    g_store.aof_enabled = aof_rewrite_locked();
+    if (!g_store.aof_enabled) {
+      std::cerr << "appendonly rewrite/open failed for " << aof_path << "\n";
+      return 1;
+    }
+    if (g_store.fsync_policy == Fsync::kEverysec) {
+      std::thread(fsync_loop).detach();
+    }
   }
 
   signal(SIGPIPE, SIG_IGN);

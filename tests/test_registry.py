@@ -48,9 +48,15 @@ class KVServer:
         self.proc = subprocess.Popen(
             args, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True
         )
-        line = self.proc.stdout.readline()
-        m = re.search(r"ready on port (\d+)", line)
-        assert m, f"unexpected kvstored output: {line!r}"
+        # Diagnostics (e.g. the corrupt-AOF-tail warning) may precede the
+        # ready line — skip them, bounded.
+        m = None
+        for _ in range(10):
+            line = self.proc.stdout.readline()
+            m = re.search(r"ready on port (\d+)", line)
+            if m or not line:
+                break
+        assert m, f"kvstored never reported ready: {line!r}"
         self.port = int(m.group(1))
 
     def stop(self):
@@ -291,3 +297,75 @@ class TestReviewRegressions:
             c.close()
             proc.terminate()
             proc.wait(timeout=5)
+
+
+class TestAOFHygiene:
+    """AOF compaction + fsync policy (VERDICT r3 weak #8: the r3 log grew
+    unboundedly — one record per heartbeat forever — and every restart
+    replayed all of it)."""
+
+    def test_startup_compacts_heartbeat_history(self, tmp_path):
+        """1000 overwrites of one key compact to ~one SET at restart; the
+        state survives byte-for-byte."""
+        aof = str(tmp_path / "registry.aof")
+        srv = KVServer(appendonly=aof)
+        try:
+            with Client(port=srv.port) as c:
+                for i in range(1000):
+                    c.set("node/n1/heartbeat", str(1000000 + i))
+                c.set("node/n1", "inventory-json")
+        finally:
+            srv.stop()
+        grown = os.path.getsize(aof)
+        srv2 = KVServer(appendonly=aof)
+        try:
+            compacted = os.path.getsize(aof)
+            # 1001 records -> 2 live keys: the rewrite must shed >95%.
+            assert compacted < grown / 20, (grown, compacted)
+            with Client(port=srv2.port) as c:
+                assert c.get("node/n1/heartbeat") == str(1000000 + 999)
+                assert c.get("node/n1") == "inventory-json"
+        finally:
+            srv2.stop()
+
+    def test_auto_rewrite_bounds_log_growth(self, tmp_path):
+        """The live log rewrites itself once it doubles past the last
+        compaction (1 MiB floor): hammering one key with large values must
+        not grow the file linearly with write count."""
+        aof = str(tmp_path / "registry.aof")
+        srv = KVServer(appendonly=aof)
+        try:
+            big = "x" * 4096
+            with Client(port=srv.port) as c:
+                for i in range(2000):           # ~8 MiB of raw records
+                    c.set("fat-key", big + str(i))
+                assert c.get("fat-key") == big + "1999"
+            size = os.path.getsize(aof)
+            # Without auto-rewrite this is ~8 MiB; with it the log stays
+            # within ~2x the single-record size plus the floor.
+            assert size < 3 * (1 << 20), size
+        finally:
+            srv.stop()
+
+    def test_appendfsync_flag_accepted(self, tmp_path):
+        for policy in ("always", "everysec", "no"):
+            aof = str(tmp_path / f"a-{policy}.aof")
+            proc = subprocess.Popen(
+                [build_binary(), "--port", "0", "--appendonly", aof,
+                 "--appendfsync", policy],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            try:
+                line = proc.stdout.readline()
+                m = re.search(r"ready on port (\d+)", line)
+                assert m, (policy, line)
+                with Client(port=int(m.group(1))) as c:
+                    c.set("k", policy)
+                    assert c.get("k") == policy
+            finally:
+                proc.terminate()
+                proc.wait(timeout=5)
+        # Garbage policy is rejected up front.
+        proc = subprocess.Popen(
+            [build_binary(), "--appendfsync", "sometimes"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        assert proc.wait(timeout=5) != 0
