@@ -34,7 +34,8 @@ log = logging.getLogger("tpu-scheduler")
 
 def build_scheduler(server, config: SchedulerConfig,
                     metrics: Registry | None = None,
-                    leader_elect: bool = False) -> Scheduler:
+                    leader_elect: bool = False,
+                    allow_simulated_reshape: bool = False) -> Scheduler:
     """Wire plugins + sidecar clients into a ready-to-start Scheduler."""
     elector = None
     if leader_elect:
@@ -90,10 +91,13 @@ def build_scheduler(server, config: SchedulerConfig,
     except Exception as e:  # noqa: BLE001
         log.warning("metrics endpoint unavailable (%s)", e)
 
-    # Without a registry, reshape confirmation is simulated: take ~2 s so a
-    # demo shows the real applying→idle window instead of an instant flip.
+    # Without a registry, reshape confirmation can only be SIMULATED.
+    # Demo mode opts in (taking ~2 s so the applying→idle window shows);
+    # in-cluster the reshaper refuses instead — a timer must never stand
+    # in for a hardware observation (r3 weak #7).
     reshaper = SliceReshaper(sched.descriptor, registry=registry,
-                             auto_confirm_delay_s=0.0 if registry else 2.0)
+                             auto_confirm_delay_s=0.0 if registry else 2.0,
+                             simulate_without_registry=allow_simulated_reshape)
     tpu = TPUPlugin(sched.handle, registry=registry, prom=prom,
                     recommender=recommender, reshaper=reshaper)
     gang = GangPlugin(sched.handle)
@@ -195,7 +199,8 @@ def main(argv=None) -> int:
         server = KubeAPIServer(base_url=args.apiserver)
         log.info("connected to kube-apiserver at %s", server.base_url)
     config = SchedulerConfig.from_env()
-    sched = build_scheduler(server, config, leader_elect=args.leader_elect)
+    sched = build_scheduler(server, config, leader_elect=args.leader_elect,
+                            allow_simulated_reshape=args.demo is not None)
 
     exporter = None
     if args.metrics_port:

@@ -410,8 +410,21 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
                 gen = "V5E"
             column = f"{chips}P_{gen}"
 
+            from ..recommender.collector import current_neighbors
+
+            pod_name = os.environ.get("HOSTNAME", "")
+            env_neighbors = os.environ.get("TPU_NEIGHBORS", "")
+
             def publish(qps: float) -> None:  # noqa: F811
-                publish_observation(reg, workload_name, column, qps)
+                # Samples taken next to co-residents are interference
+                # measurements, not solo throughput (collector.py). The
+                # neighbor list is read LIVE from the registry (the
+                # scheduler refreshes it when later binds change this
+                # partition's co-residency); the bind-time env is only the
+                # fallback.
+                publish_observation(
+                    reg, workload_name, column, qps,
+                    neighbors=current_neighbors(reg, pod_name, env_neighbors))
         except Exception as e:  # noqa: BLE001 — observability never kills work
             print(f"observation publishing disabled: {e}", flush=True)
 

@@ -59,6 +59,7 @@ ENV_TOPOLOGY = "TPU_TOPOLOGY"
 ENV_ACCELERATOR = "TPU_ACCELERATOR_TYPE"
 ENV_HBM_LIMIT = "TPU_HBM_LIMIT_BYTES"
 ENV_DUTY_PCT = "TPU_DUTY_CYCLE_PERCENTAGE"
+ENV_NEIGHBORS = "TPU_NEIGHBORS"
 ENV_SLO = "SLO"
 
 _GEN_SHORT = {TPUGen.V5E: "V5E", TPUGen.V6E: "V6E", TPUGen.V5P: "V5P", TPUGen.V4: "V4"}
@@ -362,6 +363,37 @@ class TPUPlugin(
             # {nodeName: selectedUUID} parity (gpu_plugins.go:760-772) so
             # GetSLOs-style reverse lookups can attribute pods to partitions.
             data[node_name] = part.key
+            # Co-located workloads on this partition, injected so the
+            # workload can tag its throughput observations — the collector
+            # folds tagged samples into the interference matrix (the r3
+            # loop only ever fed configurations). Besides the (static) env
+            # for the pod being bound, the LIVE per-pod registry keys of
+            # every affected resident are refreshed: an already-running
+            # tenant must stop tagging its samples as solo the moment a
+            # neighbor arrives, or its degraded throughput poisons the
+            # solo baseline. (Departures are not tracked — a stale tag
+            # folds a ~zero delta into interference, the damped direction.)
+            residents = self._partition_residents_confirmed(
+                pod, node_name, part)
+            neighbors = sorted({self._workload_of(p) for p in residents})
+            if neighbors:
+                data[ENV_NEIGHBORS] = ",".join(neighbors)
+            if self.registry is not None:
+                my_workload = self._workload_of(pod)
+                try:
+                    set_fn = getattr(self.registry, "set", None)
+                    if set_fn is not None:
+                        set_fn(f"neighbors/{pod.metadata.name}",
+                               ",".join(neighbors))
+                        for r in residents:
+                            others = sorted(
+                                {self._workload_of(q) for q in residents
+                                 if q.metadata.uid != r.metadata.uid}
+                                | {my_workload})
+                            set_fn(f"neighbors/{r.metadata.name}",
+                                   ",".join(others))
+                except Exception:  # noqa: BLE001 — observability never blocks binds
+                    log.debug("neighbor registry update failed", exc_info=True)
         if decision.accelerator:
             data[ENV_ACCELERATOR] = decision.accelerator
         if decision.rightsized_config:
@@ -382,6 +414,47 @@ class TPUPlugin(
         if not written:
             log.info("pod %s has no EnvFrom ConfigMap; assignment not injected",
                      pod.metadata.key)
+
+    def _partition_residents_confirmed(
+        self, pod: Pod, node_name: str, part: Partition
+    ) -> List[Pod]:
+        """Chip-consuming pods whose CONFIRMED assignment is this partition
+        (excluding the pod being bound). Deliberately NOT
+        residents_by_partition: its partitions[0] fallback is conservative
+        for capacity accounting but would FABRICATE co-residency for pods
+        whose assignment couldn't be read back — interference rows keyed on
+        a neighbor that never shared chips."""
+        info = self.handle.cache.snapshot().get(node_name)
+        if info is None:
+            return []
+        with self._assign_mu:
+            memo = dict(self._assigned_memo)
+        out = []
+        for p in info.pods:
+            if p.spec.tpu_chips() == 0 or p.metadata.uid == pod.metadata.uid:
+                continue
+            held = memo.get(p.metadata.uid)
+            if held is not None and held[0] == node_name:
+                key = held[1]
+            else:
+                key = self._assigned_partition(p, node_name)
+            if key == part.key:
+                out.append(p)
+        return out
+
+    @staticmethod
+    def _workload_of(pod: Pod) -> str:
+        """Interference-matrix identity of a pod: its WORKLOAD_NAME env
+        (the label the train matrices key on), else the pod name normalized
+        to the matrix convention (dashes→underscores, trailing replica
+        ordinal stripped) so learned columns merge with seed columns and
+        match_interference's substring rule can hit them."""
+        name = pod.get_env("WORKLOAD_NAME")
+        if name:
+            return name
+        base = pod.metadata.name.replace("-", "_")
+        head, _, tail = base.rpartition("_")
+        return head if head and tail.isdigit() else base
 
     # -- decision core -----------------------------------------------------
     def _decide(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[Decision, float]:

@@ -186,11 +186,12 @@ def main() -> None:  # pragma: no cover — exercised via the CLI
     configurations_path = os.environ.get(
         "CONFIGURATIONS_DATA_PATH",
         os.path.join(here, "data/configurations_train.tsv"))
+    interference_path = os.environ.get(
+        "INTERFERENCE_DATA_PATH",
+        os.path.join(here, "data/interference_train.tsv"))
     server = RecommenderServer(
         configurations_path=configurations_path,
-        interference_path=os.environ.get(
-            "INTERFERENCE_DATA_PATH", os.path.join(here, "data/interference_train.tsv")
-        ),
+        interference_path=interference_path,
         port=int(os.environ.get("PORT", "32700")),
         retrain_interval_s=float(os.environ.get("JOB_DELAY", "30")),
     ).start()
@@ -211,6 +212,7 @@ def main() -> None:  # pragma: no cover — exercised via the CLI
         collector = Collector(
             reg, configurations_path,
             interval_s=float(os.environ.get("JOB_DELAY", "30")),
+            interference_path=interference_path,
         ).start()
         print(f"collector polling registry at {rc.host}:{rc.port}",
               flush=True)

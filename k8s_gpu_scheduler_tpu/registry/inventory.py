@@ -25,8 +25,12 @@ def node_key(node_name: str) -> str:
     return NODE_KEY_PREFIX + node_name
 
 
-def observed_key(workload: str, column: str) -> str:
-    return f"{OBSERVED_KEY_PREFIX}{workload}/{column}"
+def observed_key(workload: str, column: str, co_located: bool = False) -> str:
+    """Solo and co-located samples get DISTINCT keys: they feed different
+    matrices (configurations vs interference), and sharing one key would
+    let whichever replica wrote last clobber the other stream."""
+    suffix = "/co" if co_located else ""
+    return f"{OBSERVED_KEY_PREFIX}{workload}/{column}{suffix}"
 
 
 @dataclass
@@ -34,15 +38,23 @@ class Observation:
     """One measured workload throughput sample, published by the workload
     itself (models print tok/s; models/llama.py pushes it here when the
     registry env is set). The recommender's Collector folds these back into
-    the train matrix — closing the loop BASELINE's north star describes
+    the train matrices — closing the loop BASELINE's north star describes
     ("right-sizes pod requests against observed XLA-step utilization"),
     which round 2 left open (VERDICT.md weak #5): the matrices were static
-    seed data forever."""
+    seed data forever.
+
+    ``neighbors`` names the workloads co-located on the same partition when
+    the sample was taken (the scheduler injects them as TPU_NEIGHBORS at
+    PostBind). A sample WITH neighbors is an interference measurement — the
+    collector folds its throughput DELTA vs the solo configurations cell
+    into the interference matrix; a sample without neighbors is the solo
+    throughput itself."""
 
     workload: str      # train-matrix row label, e.g. llama3_8b_serve
     column: str        # train-matrix column, e.g. 4P_V5E
     qps: float         # observed throughput (requests/s or steps/s)
     at: float = 0.0    # unix ts of the sample
+    neighbors: List[str] = field(default_factory=list)
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), sort_keys=True)
@@ -53,6 +65,7 @@ class Observation:
         return Observation(
             workload=d.get("workload", ""), column=d.get("column", ""),
             qps=float(d.get("qps", 0.0)), at=float(d.get("at", 0.0)),
+            neighbors=[str(n) for n in d.get("neighbors", [])],
         )
 
 

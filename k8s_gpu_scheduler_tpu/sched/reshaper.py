@@ -53,6 +53,7 @@ class SliceReshaper:
         poll_interval_s: float = 0.25,
         timeout_s: float = 60.0,
         auto_confirm_delay_s: float = 0.0,
+        simulate_without_registry: bool = True,
     ):
         self.descriptor = descriptor
         self.registry = registry
@@ -64,6 +65,13 @@ class SliceReshaper:
         # instead of pretending hardware repartitioned instantly
         # (VERDICT.md weak #7). Tests keep 0.0 for instant confirm.
         self.auto_confirm_delay_s = auto_confirm_delay_s
+        # With neither a registry NOR simulation opted into (in-cluster
+        # against real hardware without an agent feed), request() REFUSES:
+        # flipping applying→idle on a timer with no observer would tell the
+        # scheduler a repartition happened that nothing confirmed. Demo and
+        # test rigs pass True (the default keeps hermetic rigs working);
+        # cmd/scheduler.py passes False for --in-cluster.
+        self.simulate_without_registry = simulate_without_registry
         self._mu = threading.Lock()
         self._pending: Dict[str, _Pending] = {}
         self._stop = threading.Event()
@@ -98,6 +106,11 @@ class SliceReshaper:
         reference serializes with a global mutex, gpu_plugins.go:480-496)."""
         if self._stop.is_set():
             return False  # shut down — never annotate a state nobody clears
+        if self.registry is None and not self.simulate_without_registry:
+            log.warning(
+                "refusing reshape of %s: no registry to confirm the new "
+                "partitioning and simulation not enabled", node_name)
+            return False
         with self._mu:
             if node_name in self._pending:
                 return False

@@ -503,6 +503,43 @@ class TestPerChipPartitionChoice:
         assert decision.partition.chip_ids == [4, 5, 6, 7]
 
 
+class TestNeighborInjection:
+    def test_second_tenant_gets_neighbor_names(self):
+        """PostBind injects TPU_NEIGHBORS = co-residents on the same
+        partition, so the workload can tag its throughput samples as
+        interference measurements (collector.py folds the delta)."""
+        server = APIServer()
+        server.create(ConfigMap(metadata=ObjectMeta(name="cm-n1"), data={}))
+        server.create(ConfigMap(metadata=ObjectMeta(name="cm-n2"), data={}))
+        reg = FakeRegistry()
+        reg.publish("n1", utilization=0.0)
+        sched = make_scheduler(server, registry=reg)
+        server.create(mk_node("n1", annotations={ANN_SLICE_CONFIG: "2x4"}))
+        server.create(mk_pod("tenant-a", chips=0, cm="cm-n1"))
+        sched.start()
+        try:
+            assert wait_until(
+                lambda: server.get("Pod", "tenant-a", "default").spec.node_name)
+            # tenant-a is a CPU pod — no partition, no neighbors entry.
+            # tenant-b takes the whole-board partition where a chip pod
+            # resides; seed that resident first.
+            server.create(mk_pod("resident", chips=4, cm="cm-n1"))
+            assert wait_until(
+                lambda: server.get("Pod", "resident", "default").spec.node_name)
+            server.create(mk_pod("tenant-b", chips=4, cm="cm-n2"))
+            assert wait_until(
+                lambda: server.get("Pod", "tenant-b", "default").spec.node_name)
+            cm = server.get("ConfigMap", "cm-n2", "default")
+            assert cm.data.get("TPU_NEIGHBORS") == "resident", cm.data
+            # The RESIDENT's live registry key was refreshed too — it must
+            # stop tagging samples as solo now that tenant-b moved in
+            # (names are workload identities, replica ordinals stripped).
+            assert reg.get("neighbors/resident") == "tenant_b"
+            assert reg.get("neighbors/tenant-b") == "resident"
+        finally:
+            sched.stop()
+
+
 # --- end-to-end: assignment + side-effect-free score -------------------------
 
 

@@ -289,6 +289,72 @@ class TestCollector:
         publish_observation(reg, "llama3_8b_serve", "1P_V5E", 60.0)
         assert collector.collect_once()
 
+    def test_colocation_delta_folds_into_interference_matrix(self, tmp_path):
+        """VERDICT r3 #7 'done' criterion: a neighbors-tagged sample updates
+        an interference row, and the next ImputeInterference reflects it.
+        Solo baseline 20 QPS, co-located 14 alongside one neighbor →
+        degradation 6."""
+        import shutil
+
+        from k8s_gpu_scheduler_tpu.recommender.collector import (
+            Collector, publish_observation,
+        )
+        from k8s_gpu_scheduler_tpu.recommender.server import _Table, load_matrix
+
+        conf = self._seed_tsv(tmp_path)
+        intf = str(tmp_path / "intf.tsv")
+        shutil.copy(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "..",
+                "k8s_gpu_scheduler_tpu", "recommender", "data",
+                "interference_train.tsv"), intf)
+        reg = FakeRegistryKV()
+        collector = Collector(reg, conf, interval_s=999,
+                              interference_path=intf)
+        # Solo baseline first (no neighbors → configurations).
+        publish_observation(reg, "llama3_8b_serve", "4P_V5E", 20.0)
+        assert collector.collect_once()
+        # Then a co-located sample: 14 QPS next to bert_base_serve.
+        publish_observation(reg, "llama3_8b_serve", "4P_V5E", 14.0,
+                            neighbors=["bert_base_serve"])
+        assert collector.collect_once()
+
+        labels, columns, X = load_matrix(intf)
+        assert "llama3_8b_serve_V5E" in labels
+        assert "bert_base_serve" in columns
+        i = labels.index("llama3_8b_serve_V5E")
+        j = columns.index("bert_base_serve")
+        assert X[i][j] == pytest.approx(6.0)
+        # The serving table sees it on the next (md5-triggered) reload.
+        table = _Table(intf)
+        result, cols = table.lookup("llama3-8b-serve-0_V5E")
+        assert result[cols.index("bert_base_serve")] == pytest.approx(6.0)
+
+    def test_interference_sample_without_baseline_deferred(self, tmp_path):
+        """A co-located sample with no solo baseline can't produce a delta
+        — it must be skipped without corrupting either matrix."""
+        import shutil
+
+        from k8s_gpu_scheduler_tpu.recommender.collector import (
+            Collector, publish_observation,
+        )
+
+        conf = self._seed_tsv(tmp_path)
+        intf = str(tmp_path / "intf.tsv")
+        shutil.copy(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "..",
+                "k8s_gpu_scheduler_tpu", "recommender", "data",
+                "interference_train.tsv"), intf)
+        before = open(intf).read()
+        reg = FakeRegistryKV()
+        collector = Collector(reg, conf, interval_s=999,
+                              interference_path=intf)
+        publish_observation(reg, "never_measured_workload", "4P_V5E", 9.0,
+                            neighbors=["bert_base_serve"])
+        assert not collector.collect_once()
+        assert open(intf).read() == before
+
     def test_end_to_end_through_grpc_server(self, tmp_path):
         """Full loop over the wire: gRPC reply BEFORE vs AFTER an
         observation lands and the md5-watch retrains."""

@@ -216,3 +216,21 @@ class TestSchedulerIntegration:
         finally:
             sched.stop()
             reshaper.stop()
+
+
+class TestNoRegistryRefusal:
+    def test_in_cluster_mode_refuses_without_confirmation_source(self):
+        """r3 weak #7: with no registry AND simulation not opted into, a
+        reshape request is refused — applying→idle must never flip on a
+        timer nothing observed."""
+        server = APIServer()
+        server.create(mk_node("n1"))
+        sched = Scheduler(server, profile=Profile(), config=SchedulerConfig())
+        reshaper = SliceReshaper(sched.descriptor, registry=None,
+                                 simulate_without_registry=False)
+        try:
+            assert not reshaper.request("n1", "2x2")
+            node = server.get("Node", "n1", "default")
+            assert ANN_RESHAPE_STATE not in node.metadata.annotations
+        finally:
+            reshaper.stop()
