@@ -240,26 +240,9 @@ def forward_with_aux(
     x = _constrain(x, mesh, P(("dp", "fsdp"), "sp", None))
 
     def block(x, blk):
-        h = rms_norm(x, blk["attn_norm"])
-        q = (h @ blk["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
-        k = (h @ blk["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ blk["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-        q, k = apply_rope(q, angles), apply_rope(k, angles)
-        attn = _attention(cfg, mesh, q, k, v)
-        x = x + attn.reshape(B, T, cfg.n_heads * cfg.head_dim) @ blk["wo"]
-        h = rms_norm(x, blk["mlp_norm"])
-        if cfg.n_experts > 1:
-            from ..ops.moe import moe_ffn
-
-            moe_out, aux = moe_ffn(
-                h, blk["router"], blk["w_gate"], blk["w_up"], blk["w_down"],
-                top_k=cfg.moe_top_k,
-                capacity_factor=cfg.moe_capacity_factor,
-            )
-            x = x + moe_out
-        else:
-            x = x + swiglu(h, blk["w_gate"], blk["w_up"], blk["w_down"])
-            aux = jnp.zeros((), jnp.float32)
+        x = attn_sublayer(
+            cfg, x, blk, angles, lambda q, k, v: _attention(cfg, mesh, q, k, v))
+        x, aux = mlp_sublayer(cfg, x, blk)
         x = _constrain(x, mesh, P(("dp", "fsdp"), "sp", None))
         return x, aux
 
@@ -267,6 +250,37 @@ def forward_with_aux(
     x, aux = jax.lax.scan(block_fn, x, params["blocks"])
     x = rms_norm(x, params["final_norm"])
     return (x @ params["lm_head"]).astype(jnp.float32), aux.mean()
+
+
+def attn_sublayer(cfg: LlamaConfig, x, blk, angles, attention_fn):
+    """pre-norm attention half of a decoder block — THE one definition;
+    forward_with_aux and the pipeline path (models/pipeline.py) both call
+    it, so block-math changes can never diverge between layouts."""
+    B, T, _ = x.shape
+    h = rms_norm(x, blk["attn_norm"])
+    q = (h @ blk["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = (h @ blk["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ blk["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    q, k = apply_rope(q, angles), apply_rope(k, angles)
+    attn = attention_fn(q, k, v)
+    return x + attn.reshape(B, T, cfg.n_heads * cfg.head_dim) @ blk["wo"]
+
+
+def mlp_sublayer(cfg: LlamaConfig, x, blk):
+    """pre-norm MLP half: dense SwiGLU or routed experts. Returns
+    (x, balance aux — 0 for dense)."""
+    h = rms_norm(x, blk["mlp_norm"])
+    if cfg.n_experts > 1:
+        from ..ops.moe import moe_ffn
+
+        moe_out, aux = moe_ffn(
+            h, blk["router"], blk["w_gate"], blk["w_up"], blk["w_down"],
+            top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor,
+        )
+        return x + moe_out, aux
+    return (x + swiglu(h, blk["w_gate"], blk["w_up"], blk["w_down"]),
+            jnp.zeros((), jnp.float32))
 
 
 def _constrain(x, mesh, spec):

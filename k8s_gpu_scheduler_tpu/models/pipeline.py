@@ -1,0 +1,165 @@
+"""Pipeline parallelism — GPipe-style microbatched stages over a 'pp' axis.
+
+The sixth and final parallelism axis (DP/FSDP/TP/SP/EP live in
+parallel/sharding.py rules; the reference has none of them — SURVEY.md §2
+parallelism checklist). TPU-native shape:
+
+- The layer stack [L, ...] is SHARDED over 'pp': stage s owns L/P
+  contiguous layers — no weight gathering, ever (contrast FSDP, which
+  all-gathers per layer).
+- The schedule is one ``lax.scan`` over M + P - 1 ticks inside a
+  ``shard_map``: at tick t, stage s runs microbatch t - s through its
+  local layers; activations hop stage→stage via ``lax.ppermute`` (XLA
+  lowers it onto the ICI ring). Bubble fraction is the usual
+  (P-1)/(M+P-1) — pick microbatches >> stages.
+- The backward needs NO bespoke code: ``ppermute`` is differentiable (its
+  transpose is the reverse permutation), so ``jax.value_and_grad``
+  through the shard_map runs the reverse schedule automatically — the
+  scan's saved activations play the role of GPipe's stashed activations.
+- Invalid ticks (the pipeline fill/drain bubble) compute garbage
+  activations; they are masked OUT of the loss, so autodiff assigns them
+  exactly zero gradient — compute wasted, correctness untouched.
+
+Embedding and lm_head are replicated: stage 0 applies the embedding,
+the last stage applies the head and accumulates token NLL; a ``psum``
+makes the scalar loss replicated so out_specs=P() typechecks. loss parity
+with the single-device path is asserted in tests/test_models.py.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import dense_attention
+from ..ops.layers import rms_norm, rope_freqs
+from .llama import LlamaConfig, attn_sublayer, mlp_sublayer
+
+
+def _block(cfg: LlamaConfig, x, blk, angles):
+    """One decoder layer on [mb, T, D] — the SHARED sublayer helpers from
+    llama.py (the pipeline scans over TIME ticks, not layers, but the
+    per-layer math is one definition)."""
+    x = attn_sublayer(
+        cfg, x, blk, angles,
+        lambda q, k, v: dense_attention(q, k, v, causal=True))
+    x, _ = mlp_sublayer(cfg, x, blk)
+    return x
+
+
+def pp_loss_fn(params: Dict, batch: Dict, cfg: LlamaConfig, mesh: Mesh,
+               microbatches: int) -> jax.Array:
+    """Causal-LM loss computed through the pipeline. batch["tokens"] is
+    [B, T] with B divisible by ``microbatches``; layers (cfg.n_layers)
+    must divide by the pp axis size."""
+    n_stages = mesh.shape["pp"]
+    assert cfg.n_layers % n_stages == 0, (cfg.n_layers, n_stages)
+    if cfg.n_experts > 1:
+        raise NotImplementedError(
+            "pipeline parallelism does not compose with MoE configs yet "
+            "(route expert dispatch per stage); use dense layers")
+    M = microbatches
+    B, T = batch["tokens"].shape
+    assert B % M == 0, (B, M)
+    mb = B // M
+    angles = rope_freqs(cfg.head_dim, T, cfg.rope_theta)
+
+    def stage_program(blocks, embed, lm_head, final_norm, tokens, targets):
+        stage = jax.lax.axis_index("pp")
+        last = n_stages - 1
+        tok_mb = tokens.reshape(M, mb, T)
+        tgt_mb = targets.reshape(M, mb, T)
+
+        def run_local(x):
+            def one(x, blk):
+                return _block(cfg, x, blk, angles), None
+
+            one_fn = jax.checkpoint(one) if cfg.remat else one
+            x, _ = jax.lax.scan(one_fn, x, blocks)
+            return x
+
+        def tick(carry, t):
+            act, loss_sum, n_sum = carry
+            # Stage 0 injects microbatch t (clamped; invalid ticks masked
+            # out of the loss below).
+            inject = embed[tok_mb[jnp.clip(t, 0, M - 1)]].astype(cfg.dtype)
+            x = jnp.where(stage == 0, inject, act)
+            x = run_local(x)
+            # Last stage: the activation leaving at tick t belongs to
+            # microbatch t - (P-1); fold its NLL when that index is real.
+            m_idx = jnp.clip(t - last, 0, M - 1)
+            h = rms_norm(x, final_norm)
+            logits = (h @ lm_head).astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(
+                logits, tgt_mb[m_idx][..., None], axis=-1)[..., 0]
+            nll = (lse - tgt).sum()
+            valid = (stage == last) & (t >= last) & (t - last < M)
+            loss_sum = loss_sum + jnp.where(valid, nll, 0.0)
+            n_sum = n_sum + jnp.where(valid, mb * T, 0)
+            # Rotate activations one stage forward (ring; last→0 carries a
+            # dead value that stage 0 overwrites with its next inject).
+            nxt = jax.lax.ppermute(
+                x, "pp", [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            return (nxt, loss_sum, n_sum), None
+
+        act0 = jnp.zeros((mb, T, cfg.d_model), cfg.dtype)
+        (_, loss_sum, n_sum), _ = jax.lax.scan(
+            tick, (act0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+            jnp.arange(M + n_stages - 1))
+        # Only the last stage holds the sums — psum replicates the scalar.
+        total = jax.lax.psum(loss_sum, "pp")
+        count = jax.lax.psum(n_sum, "pp")
+        return total / count.astype(jnp.float32)
+
+    # Layer-stacked block leaves shard over pp; everything else replicates.
+    blocks_spec = jax.tree.map(lambda _: P("pp"), params["blocks"])
+    fn = jax.shard_map(
+        stage_program,
+        mesh=mesh,
+        in_specs=(blocks_spec, P(), P(), P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return fn(params["blocks"], params["embed"], params["lm_head"],
+              params["final_norm"], batch["tokens"], batch["targets"])
+
+
+def pp_param_shardings(cfg: LlamaConfig, mesh: Mesh) -> Dict:
+    """NamedShardings for the pipeline layout: block leaves split their
+    leading layer axis over pp, the rest replicate."""
+    def spec(path_is_block: bool):
+        return NamedSharding(mesh, P("pp") if path_is_block else P())
+
+    return {
+        "embed": spec(False),
+        "blocks": {k: spec(True) for k in
+                   ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+                    "w_gate", "w_up", "w_down")},
+        "final_norm": spec(False),
+        "lm_head": spec(False),
+    }
+
+
+def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
+                       microbatches: int):
+    """Jitted pipeline train step: (params, opt_state, batch) →
+    (params, opt_state, loss). Layer shards stay resident on their stage
+    across steps (in_shardings pin them), so the optimizer update for a
+    stage's layers also runs on that stage."""
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(pp_loss_fn)(
+            params, batch, cfg, mesh, microbatches)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(
+            lambda p, u: p + u.astype(p.dtype), params, updates)
+        return params, opt_state, loss
+
+    pshard = pp_param_shardings(cfg, mesh)
+    return jax.jit(
+        step,
+        in_shardings=(pshard, None, None),
+        donate_argnums=(0, 1),
+    )

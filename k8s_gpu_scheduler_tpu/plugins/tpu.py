@@ -430,6 +430,7 @@ class TPUPlugin(
         with self._assign_mu:
             memo = dict(self._assigned_memo)
         out = []
+        cm_cache: Dict[Tuple[str, str], object] = {}
         for p in info.pods:
             if p.spec.tpu_chips() == 0 or p.metadata.uid == pod.metadata.uid:
                 continue
@@ -437,7 +438,7 @@ class TPUPlugin(
             if held is not None and held[0] == node_name:
                 key = held[1]
             else:
-                key = self._assigned_partition(p, node_name)
+                key = self._assigned_partition(p, node_name, cm_cache)
             if key == part.key:
                 out.append(p)
         return out

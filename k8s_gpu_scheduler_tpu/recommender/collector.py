@@ -69,7 +69,7 @@ class Collector:
         except Exception as e:  # noqa: BLE001 — registry outage is routine
             log.warning("collector: registry unavailable (%s)", e)
             return False
-        observations: List[Observation] = []
+        observations: List["tuple[str, Observation]"] = []
         for key in keys:
             raw = self.registry.get(key)
             if not raw:
@@ -85,7 +85,7 @@ class Collector:
             if obs.at <= self._folded_at.get(key, -math.inf):
                 continue
             self._folded_at[key] = obs.at
-            observations.append(obs)
+            observations.append((key, obs))
         # Drop tracking for keys that vanished so the map can't grow forever.
         live = set(keys)
         for stale in [k for k in self._folded_at if k not in live]:
@@ -93,11 +93,18 @@ class Collector:
         if not observations:
             return False
 
-        solo = [o for o in observations if not o.neighbors]
-        co = [o for o in observations if o.neighbors]
+        solo = [o for _, o in observations if not o.neighbors]
+        co = [(k, o) for k, o in observations if o.neighbors]
         changed = self._fold_configurations(solo)
         if self.interference_path is not None and co:
-            changed = self._fold_interference(co) or changed
+            folded, deferred = self._fold_interference([o for _, o in co])
+            changed = folded or changed
+            # A sample whose solo baseline doesn't exist yet is genuinely
+            # DEFERRED: forget its fold timestamp so the next pass retries
+            # it (by then the baseline may have landed).
+            for key, obs in co:
+                if id(obs) in deferred:
+                    self._folded_at.pop(key, None)
         return changed
 
     def _fold_configurations(self, observations: List[Observation]) -> bool:
@@ -133,7 +140,9 @@ class Collector:
                      len(observations), self.path)
         return changed
 
-    def _fold_interference(self, observations: List[Observation]) -> bool:
+    def _fold_interference(
+        self, observations: List[Observation]
+    ) -> "tuple[bool, set]":
         """Co-located samples → interference rows. The degradation is the
         solo configurations cell minus the observed co-located QPS, split
         evenly across the neighbors present (the reference's matrix stores
@@ -141,7 +150,9 @@ class Collector:
         first-order attribution). Row key is the reference's
         ``{workload}_{gen}`` convention (recom_server row labels); columns
         are neighbor workload names and may grow (every row pads with
-        NaN — the imputer fills them)."""
+        NaN — the imputer fills them). Returns (changed, ids of deferred
+        observations — no baseline yet, retry next pass)."""
+        deferred: set = set()
         labels, columns, X = load_matrix(self.path)
 
         def solo_qps(workload: str, column: str) -> Optional[float]:
@@ -161,6 +172,7 @@ class Collector:
                 log.info("collector: no solo baseline for %s/%s — "
                          "interference sample deferred",
                          obs.workload, obs.column)
+                deferred.add(id(obs))
                 continue
             delta = max(0.0, base - obs.qps) / max(len(obs.neighbors), 1)
             gen = obs.column.rsplit("_", 1)[-1]
@@ -188,8 +200,9 @@ class Collector:
         if changed:
             self._write(self.interference_path, ilabels, icolumns, irows)
             log.info("collector: folded %d co-location observation(s) "
-                     "into %s", len(observations), self.interference_path)
-        return changed
+                     "into %s", len(observations) - len(deferred),
+                     self.interference_path)
+        return changed, deferred
 
     @staticmethod
     def _write(path: str, labels: List[str], columns: List[str],

@@ -354,6 +354,16 @@ class TestCollector:
                             neighbors=["bert_base_serve"])
         assert not collector.collect_once()
         assert open(intf).read() == before
+        # Genuinely deferred, not dropped: once the solo baseline lands,
+        # the SAME (unchanged-timestamp) sample folds on the next pass.
+        publish_observation(reg, "never_measured_workload", "4P_V5E", 15.0)
+        assert collector.collect_once()
+        from k8s_gpu_scheduler_tpu.recommender.server import load_matrix
+
+        labels, columns, X = load_matrix(intf)
+        i = labels.index("never_measured_workload_V5E")
+        j = columns.index("bert_base_serve")
+        assert X[i][j] == pytest.approx(15.0 - 9.0)
 
     def test_end_to_end_through_grpc_server(self, tmp_path):
         """Full loop over the wire: gRPC reply BEFORE vs AFTER an
