@@ -6,13 +6,17 @@ workloads (resnet/bert/llama), so the framework carries a small TPU-native
 model zoo: everything jit-compiled, bf16, static-shaped, sharded via
 parallel/ — the flagship (llama) is what __graft_entry__/bench.py drive.
 """
-from .llama import LlamaConfig, init_params, forward, loss_fn, make_train_step
+from .llama import (
+    LlamaConfig, forward, forward_with_aux, init_params, loss_fn,
+    make_train_step,
+)
 from .bert import BertConfig
 from .resnet import ResNetConfig
 from .serving import (
-    cached_attention, forward_with_cache, generate, init_cache,
-    make_server_step,
+    ContinuousBatcher, cached_attention, forward_with_cache, generate,
+    init_cache, make_server_step,
 )
+from .pipeline import make_pp_train_step, pp_loss_fn
 
 __all__ = [
     "LlamaConfig",
@@ -20,6 +24,7 @@ __all__ = [
     "ResNetConfig",
     "init_params",
     "forward",
+    "forward_with_aux",
     "loss_fn",
     "make_train_step",
     "cached_attention",
@@ -27,4 +32,7 @@ __all__ = [
     "generate",
     "init_cache",
     "make_server_step",
+    "ContinuousBatcher",
+    "make_pp_train_step",
+    "pp_loss_fn",
 ]

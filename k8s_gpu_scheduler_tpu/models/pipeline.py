@@ -60,6 +60,11 @@ def pp_loss_fn(params: Dict, batch: Dict, cfg: LlamaConfig, mesh: Mesh,
         raise NotImplementedError(
             "pipeline parallelism does not compose with MoE configs yet "
             "(route expert dispatch per stage); use dense layers")
+    if cfg.attn_impl != "dense":
+        raise NotImplementedError(
+            f"pipeline parallelism runs dense attention only (got "
+            f"attn_impl={cfg.attn_impl!r}); flash/ring/ulysses per stage "
+            f"is future work")
     M = microbatches
     B, T = batch["tokens"].shape
     assert B % M == 0, (B, M)
@@ -129,17 +134,22 @@ def pp_loss_fn(params: Dict, batch: Dict, cfg: LlamaConfig, mesh: Mesh,
 
 def pp_param_shardings(cfg: LlamaConfig, mesh: Mesh) -> Dict:
     """NamedShardings for the pipeline layout: block leaves split their
-    leading layer axis over pp, the rest replicate."""
-    def spec(path_is_block: bool):
-        return NamedSharding(mesh, P("pp") if path_is_block else P())
+    leading layer axis over pp, the rest replicate. Block keys come from
+    param_axes — the one definition of the param tree — so a new block
+    param can't silently desynchronize jit's in_shardings."""
+    from .llama import param_axes
 
-    return {
-        "embed": spec(False),
-        "blocks": {k: spec(True) for k in
-                   ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
-                    "w_gate", "w_up", "w_down")},
-        "final_norm": spec(False),
-        "lm_head": spec(False),
+    axes = param_axes(cfg)
+    return jax.tree.map(
+        lambda _: NamedSharding(mesh, P()),
+        {k: v for k, v in axes.items() if k != "blocks"},
+        is_leaf=lambda x: isinstance(x, tuple),
+    ) | {
+        "blocks": jax.tree.map(
+            lambda _: NamedSharding(mesh, P("pp")),
+            axes["blocks"],
+            is_leaf=lambda x: isinstance(x, tuple),
+        )
     }
 
 

@@ -9,6 +9,7 @@ rebuild to treat as first-class.
 from .layers import apply_rope, rms_norm, rope_freqs, swiglu
 from .attention import dense_attention, ring_attention, ulysses_attention
 from .flash_attention import flash_attention, flash_attention_diff
+from .moe import load_balancing_loss, moe_ffn
 
 __all__ = [
     "rms_norm",
@@ -20,4 +21,6 @@ __all__ = [
     "ulysses_attention",
     "flash_attention",
     "flash_attention_diff",
+    "moe_ffn",
+    "load_balancing_loss",
 ]

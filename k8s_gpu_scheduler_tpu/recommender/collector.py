@@ -97,14 +97,13 @@ class Collector:
         co = [(k, o) for k, o in observations if o.neighbors]
         changed = self._fold_configurations(solo)
         if self.interference_path is not None and co:
-            folded, deferred = self._fold_interference([o for _, o in co])
+            folded, deferred_keys = self._fold_interference(co)
             changed = folded or changed
             # A sample whose solo baseline doesn't exist yet is genuinely
             # DEFERRED: forget its fold timestamp so the next pass retries
             # it (by then the baseline may have landed).
-            for key, obs in co:
-                if id(obs) in deferred:
-                    self._folded_at.pop(key, None)
+            for key in deferred_keys:
+                self._folded_at.pop(key, None)
         return changed
 
     def _fold_configurations(self, observations: List[Observation]) -> bool:
@@ -141,7 +140,7 @@ class Collector:
         return changed
 
     def _fold_interference(
-        self, observations: List[Observation]
+        self, observations: List["tuple[str, Observation]"]
     ) -> "tuple[bool, set]":
         """Co-located samples → interference rows. The degradation is the
         solo configurations cell minus the observed co-located QPS, split
@@ -150,8 +149,9 @@ class Collector:
         first-order attribution). Row key is the reference's
         ``{workload}_{gen}`` convention (recom_server row labels); columns
         are neighbor workload names and may grow (every row pads with
-        NaN — the imputer fills them). Returns (changed, ids of deferred
-        observations — no baseline yet, retry next pass)."""
+        NaN — the imputer fills them). Takes (registry key, observation)
+        pairs; returns (changed, keys of deferred observations — no
+        baseline yet, retry next pass)."""
         deferred: set = set()
         labels, columns, X = load_matrix(self.path)
 
@@ -164,7 +164,7 @@ class Collector:
         ilabels, icolumns, iX = load_matrix(self.interference_path)
         irows = [list(r) for r in iX]
         changed = False
-        for obs in observations:
+        for key, obs in observations:
             if obs.qps < 0 or not obs.workload:
                 continue
             base = solo_qps(obs.workload, obs.column)
@@ -172,7 +172,7 @@ class Collector:
                 log.info("collector: no solo baseline for %s/%s — "
                          "interference sample deferred",
                          obs.workload, obs.column)
-                deferred.add(id(obs))
+                deferred.add(key)
                 continue
             delta = max(0.0, base - obs.qps) / max(len(obs.neighbors), 1)
             gen = obs.column.rsplit("_", 1)[-1]
