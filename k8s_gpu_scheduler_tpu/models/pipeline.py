@@ -35,7 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import dense_attention
 from ..ops.layers import rms_norm, rope_freqs
-from .llama import LlamaConfig, attn_sublayer, mlp_sublayer
+from .llama import LlamaConfig, attn_sublayer, mlp_sublayer, param_axes
 
 
 def _block(cfg: LlamaConfig, x, blk, angles):
@@ -137,8 +137,6 @@ def pp_param_shardings(cfg: LlamaConfig, mesh: Mesh) -> Dict:
     leading layer axis over pp, the rest replicate. Block keys come from
     param_axes — the one definition of the param tree — so a new block
     param can't silently desynchronize jit's in_shardings."""
-    from .llama import param_axes
-
     axes = param_axes(cfg)
     return jax.tree.map(
         lambda _: NamedSharding(mesh, P()),
