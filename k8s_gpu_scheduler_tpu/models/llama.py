@@ -443,13 +443,47 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
             print(f"observation publishing disabled: {e}", flush=True)
 
     if args.serve:
-        # Real serving: prefill + KV-cache greedy decode (serving.py), one
-        # jitted program per request shape. QPS is per decoded REQUEST;
-        # decode tok/s is the per-token rate the recommender right-sizes
-        # against (BASELINE config 5).
-        from .serving import make_server_step
+        # Serving (BASELINE config 5). Single-process (any local chip
+        # count — the batcher takes the mesh): the continuous batcher
+        # (serving.py — slot admission between decode chunks).
+        # Multi-process SPMD: the static-batch handler (every worker must
+        # run the identical program schedule, which per-process host-driven
+        # admission does not guarantee).
+        import numpy as _np
 
         Tp, max_new = args.prompt_len, args.max_new
+        if jax.process_count() == 1:
+            from .serving import ContinuousBatcher
+
+            n_slots = 8
+            eng = ContinuousBatcher(
+                params, cfg, n_slots=n_slots, max_len=cfg.max_seq,
+                chunk=max_new, prefill_bucket=max(Tp, 16), mesh=mesh)
+            rng = _np.random.default_rng(0)
+
+            def prompt_arr():
+                return rng.integers(0, cfg.vocab, Tp)
+
+            eng.submit(prompt_arr(), max_new=max_new + 1)
+            eng.run()                                   # compile both
+            while True:
+                t0 = time.perf_counter()
+                n_req = 4 * n_slots
+                for _ in range(n_req):
+                    eng.submit(prompt_arr(), max_new=max_new)
+                eng.run()
+                dt = time.perf_counter() - t0
+                print(f"llama serve qps={n_req / dt:.2f} "
+                      f"decode_tok_s={n_req * max_new / dt:.1f} "
+                      f"prefill_tok={n_req * Tp} slo={slo}", flush=True)
+                if publish is not None:
+                    publish(n_req / dt)
+                # ~1 Hz pacing like the static loop: each publish is a
+                # registry GET (live neighbors) + SET — a fast wave must
+                # not turn one pod into a tens-of-Hz registry hammer.
+                time.sleep(max(0.0, 1.0 - dt))
+        from .serving import make_server_step
+
         handler = make_server_step(cfg, mesh, max_new, max_len=cfg.max_seq)
         prompt = tokens[:, :Tp]
         handler(params, prompt).block_until_ready()  # compile
