@@ -266,17 +266,25 @@ def attn_sublayer(cfg: LlamaConfig, x, blk, angles, attention_fn):
     return x + attn.reshape(B, T, cfg.n_heads * cfg.head_dim) @ blk["wo"]
 
 
-def mlp_sublayer(cfg: LlamaConfig, x, blk):
+def mlp_sublayer(cfg: LlamaConfig, x, blk, dropless: bool = False):
     """pre-norm MLP half: dense SwiGLU or routed experts. Returns
-    (x, balance aux — 0 for dense)."""
+    (x, balance aux — 0 for dense).
+
+    ``dropless``: route with capacity k·T (no token can ever exceed it),
+    making the output a PER-TOKEN function — independent of co-batched
+    tokens and padding. Serving paths use this (capacity drops are a
+    training-throughput tradeoff; at inference they would make a request's
+    completion depend on its neighbors and on prefill padding). Training
+    keeps cfg.moe_capacity_factor."""
     h = rms_norm(x, blk["mlp_norm"])
     if cfg.n_experts > 1:
         from ..ops.moe import moe_ffn
 
+        cf = float(cfg.n_experts) if dropless else cfg.moe_capacity_factor
         moe_out, aux = moe_ffn(
             h, blk["router"], blk["w_gate"], blk["w_up"], blk["w_down"],
             top_k=cfg.moe_top_k,
-            capacity_factor=cfg.moe_capacity_factor,
+            capacity_factor=cf,
         )
         return x + moe_out, aux
     return (x + swiglu(h, blk["w_gate"], blk["w_up"], blk["w_down"]),

@@ -31,8 +31,8 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import _repeat_kv
-from ..ops.layers import apply_rope, rms_norm, rope_freqs, swiglu
-from .llama import LlamaConfig, _constrain
+from ..ops.layers import apply_rope, rms_norm, rope_freqs
+from .llama import LlamaConfig, _constrain, mlp_sublayer
 
 _NEG_INF = -1e30
 
@@ -79,13 +79,11 @@ def forward_with_cache(
     """tokens [B, t] starting at absolute position cache["len"] →
     (logits [B, t, vocab], updated cache). t is static (prefill: prompt
     length; decode: 1); the position is traced, so both programs compile
-    once and serve any request length ≤ max_seq."""
-    if cfg.n_experts > 1:
-        # The serving blocks below call the dense SwiGLU; MoE params are
-        # expert-stacked and would fail deep in a dot_general otherwise.
-        raise NotImplementedError(
-            "MoE serving is not implemented — KV-cache decode paths "
-            "(generate/ContinuousBatcher) support dense configs only")
+    once and serve any request length ≤ max_seq. MoE configs route
+    DROPLESS (mlp_sublayer dropless=True): at inference a capacity drop
+    would make a request's completion depend on co-batched tokens and on
+    prefill padding, so serving output is a per-token function; it matches
+    the training forward wherever training didn't drop."""
     B, t = tokens.shape
     pos = cache["len"]
     angles = jax.lax.dynamic_slice_in_dim(
@@ -104,8 +102,7 @@ def forward_with_cache(
         v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
         attn = cached_attention(q, k_cache, v_cache, pos)
         x = x + attn.reshape(B, t, cfg.n_heads * cfg.head_dim) @ blk["wo"]
-        h = rms_norm(x, blk["mlp_norm"])
-        x = x + swiglu(h, blk["w_gate"], blk["w_up"], blk["w_down"])
+        x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
         return x, (k_cache, v_cache)
 
     x, (k_new, v_new) = jax.lax.scan(
@@ -223,8 +220,7 @@ def _decode_chunk_fn(params, cfg: LlamaConfig, chunk: int,
             probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
             attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
             x = x + attn.reshape(B, 1, cfg.n_heads * cfg.head_dim) @ blk["wo"]
-            h = rms_norm(x, blk["mlp_norm"])
-            x = x + swiglu(h, blk["w_gate"], blk["w_up"], blk["w_down"])
+            x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
             return x, (k_cache, v_cache)
 
         x, (k, v) = jax.lax.scan(block, x, (params["blocks"], k, v))
@@ -291,9 +287,6 @@ class ContinuousBatcher:
     def __init__(self, params, cfg: LlamaConfig, n_slots: int = 8,
                  max_len: Optional[int] = None, chunk: int = 8,
                  prefill_bucket: int = 128, mesh: Optional[Mesh] = None):
-        if cfg.n_experts > 1:
-            raise NotImplementedError(
-                "MoE serving is not implemented (dense configs only)")
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
