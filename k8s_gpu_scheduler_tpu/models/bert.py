@@ -115,12 +115,17 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
     infer = jax.jit(lambda p, t: classify(p, t, cfg))
     infer(params, tokens).block_until_ready()  # compile
     slo = float(os.environ.get("SLO", "0") or 0)
+    from ..recommender.collector import make_workload_publisher
+
+    publish = make_workload_publisher()
     while True:
         t0 = time.perf_counter()
         infer(params, tokens).block_until_ready()
         qps = B / (time.perf_counter() - t0)
         print(f"bert-base qps={qps:.1f} slo={slo} "
               f"chips={os.environ.get('TPU_VISIBLE_CHIPS', '?')}", flush=True)
+        if publish is not None:
+            publish(qps)  # feedback loop (recommender/collector.py)
         time.sleep(1)
 
 

@@ -270,21 +270,28 @@ def mlp_sublayer(cfg: LlamaConfig, x, blk, dropless: bool = False):
     """pre-norm MLP half: dense SwiGLU or routed experts. Returns
     (x, balance aux — 0 for dense).
 
-    ``dropless``: route with capacity k·T (no token can ever exceed it),
-    making the output a PER-TOKEN function — independent of co-batched
-    tokens and padding. Serving paths use this (capacity drops are a
-    training-throughput tradeoff; at inference they would make a request's
-    completion depend on its neighbors and on prefill padding). Training
-    keeps cfg.moe_capacity_factor."""
+    ``dropless``: route every token to its top-k experts with no capacity
+    machinery (ops/moe.py moe_ffn_dropless), making the output a PER-TOKEN
+    function — independent of co-batched tokens and padding. Serving paths
+    use this (capacity drops are a training-throughput tradeoff; at
+    inference they would make a request's completion depend on its
+    neighbors and on prefill padding). Training keeps the Switch capacity
+    path with cfg.moe_capacity_factor."""
     h = rms_norm(x, blk["mlp_norm"])
     if cfg.n_experts > 1:
+        if dropless:
+            from ..ops.moe import moe_ffn_dropless
+
+            moe_out = moe_ffn_dropless(
+                h, blk["router"], blk["w_gate"], blk["w_up"],
+                blk["w_down"], top_k=cfg.moe_top_k)
+            return x + moe_out, jnp.zeros((), jnp.float32)
         from ..ops.moe import moe_ffn
 
-        cf = float(cfg.n_experts) if dropless else cfg.moe_capacity_factor
         moe_out, aux = moe_ffn(
             h, blk["router"], blk["w_gate"], blk["w_up"], blk["w_down"],
             top_k=cfg.moe_top_k,
-            capacity_factor=cf,
+            capacity_factor=cfg.moe_capacity_factor,
         )
         return x + moe_out, aux
     return (x + swiglu(h, blk["w_gate"], blk["w_up"], blk["w_down"]),
@@ -407,48 +414,14 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
     slo = float(os.environ.get("SLO", "0") or 0)
 
     # Observed-throughput feedback (recommender/collector.py): when the pod
-    # carries WORKLOAD_NAME and the registry is reachable, every measured
-    # interval is published as an Observation — the collector folds it into
-    # the train matrix and the recommender's next prediction is anchored on
-    # reality instead of seed data.
-    publish = None
-    workload_name = os.environ.get("WORKLOAD_NAME", "")
-    if workload_name:
-        try:
-            from ..api.topology import TPUGen
-            from ..config import SchedulerConfig
-            from ..recommender.collector import publish_observation
-            from ..registry.client import Client as RegistryClient
+    # carries WORKLOAD_NAME and the registry is reachable, measured
+    # intervals are published as Observations (live-neighbor tagged) — the
+    # collector folds them into the train matrices and the recommender's
+    # next prediction is anchored on reality instead of seed data. The ONE
+    # wiring shared with the resnet/bert entrypoints.
+    from ..recommender.collector import make_workload_publisher
 
-            rc = SchedulerConfig.from_env().registry
-            reg = RegistryClient(rc.host, rc.port, password=rc.password)
-            reg.ping()
-            chips = len([c for c in
-                         os.environ.get("TPU_VISIBLE_CHIPS", "").split(",")
-                         if c]) or n
-            try:
-                gen = TPUGen(os.environ.get("TPU_ACCELERATOR_TYPE", "")).name
-            except ValueError:
-                gen = "V5E"
-            column = f"{chips}P_{gen}"
-
-            from ..recommender.collector import current_neighbors
-
-            pod_name = os.environ.get("HOSTNAME", "")
-            env_neighbors = os.environ.get("TPU_NEIGHBORS", "")
-
-            def publish(qps: float) -> None:  # noqa: F811
-                # Samples taken next to co-residents are interference
-                # measurements, not solo throughput (collector.py). The
-                # neighbor list is read LIVE from the registry (the
-                # scheduler refreshes it when later binds change this
-                # partition's co-residency); the bind-time env is only the
-                # fallback.
-                publish_observation(
-                    reg, workload_name, column, qps,
-                    neighbors=current_neighbors(reg, pod_name, env_neighbors))
-        except Exception as e:  # noqa: BLE001 — observability never kills work
-            print(f"observation publishing disabled: {e}", flush=True)
+    publish = make_workload_publisher(n_devices=n)
 
     if args.serve:
         # Serving (BASELINE config 5). Single-process (any local chip

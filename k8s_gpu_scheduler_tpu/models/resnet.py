@@ -140,6 +140,10 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
     params, state, loss = step(params, state, batch)  # compile
     float(loss)
     slo = float(os.environ.get("SLO", "0") or 0)
+    from ..recommender.collector import make_workload_publisher
+
+    publish = make_workload_publisher()
+    last_pub = 0.0
     while True:
         t0 = time.perf_counter()
         params, state, loss = step(params, state, batch)
@@ -147,6 +151,11 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
         ips = B / (time.perf_counter() - t0)
         print(f"resnet50 img/s={ips:.1f} loss={float(loss):.3f} slo={slo} "
               f"chips={os.environ.get('TPU_VISIBLE_CHIPS', '?')}", flush=True)
+        # Feedback loop (recommender/collector.py), paced to ~1 Hz so a
+        # fast step can't hammer the registry.
+        if publish is not None and time.time() - last_pub >= 1.0:
+            publish(ips)
+            last_pub = time.time()
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -106,6 +106,38 @@ def _balance_aux(probs: jax.Array, idx: jax.Array, n_experts: int,
     return n_experts * (frac * mean_prob).sum(-1).mean()
 
 
+def moe_ffn_dropless(
+    x: jax.Array,               # [B, T, D]
+    router: jax.Array,          # [D, E]
+    w_gate: jax.Array,          # [E, D, F]
+    w_up: jax.Array,            # [E, D, F]
+    w_down: jax.Array,          # [E, F, D]
+    top_k: int = 2,
+) -> jax.Array:
+    """Dropless routing for SERVING: every token gets its top-k experts,
+    computed as a gate-masked sum over ALL experts' FFN outputs — no
+    capacity machinery at all. Identical output to moe_ffn whenever
+    moe_ffn doesn't drop (and moe_ffn with capacity >= k·T never drops),
+    but k× fewer expert FLOPs than the capacity formulation at that
+    setting and no O(T²) dispatch tensors; the E/k-fold overcompute vs
+    ideal routing is the price of staying gather-free (a per-token weight
+    gather is only memory-feasible at t=1). Per-token function: output is
+    independent of co-batched tokens and padding."""
+    logits = (x.astype(jnp.float32) @ router.astype(jnp.float32))  # [B,T,E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)              # [B,T,k]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+    # [B,T,E] combine weights: gate value where chosen, 0 elsewhere.
+    weights = jnp.einsum(
+        "btk,btke->bte", gate_vals,
+        jax.nn.one_hot(gate_idx, router.shape[1], dtype=jnp.float32))
+    g = jax.nn.silu(jnp.einsum("btd,edf->betf", x, w_gate))
+    u = jnp.einsum("btd,edf->betf", x, w_up)
+    out_e = jnp.einsum("betf,efd->betd", g * u, w_down)            # [B,E,T,D]
+    return jnp.einsum("bte,betd->btd", weights.astype(x.dtype), out_e)
+
+
 def load_balancing_loss(x: jax.Array, router: jax.Array,
                         top_k: int = 2) -> jax.Array:
     """Standalone balance loss for callers without a moe_ffn pass (the

@@ -255,6 +255,48 @@ def publish_observation(registry, workload: str, column: str,
         log.debug("observation publish failed: %s", e)
 
 
+def make_workload_publisher(n_devices: int = 1):
+    """Build a ``publish(qps)`` callable from the scheduler-injected
+    workload env (WORKLOAD_NAME row label, TPU_VISIBLE_CHIPS column,
+    registry address), or None when publishing isn't configured. The ONE
+    wiring shared by every model entrypoint (llama/resnet/bert mains) —
+    each publish reads the LIVE neighbor list so samples are tagged
+    solo vs co-located correctly as tenants come and go."""
+    import os
+
+    workload_name = os.environ.get("WORKLOAD_NAME", "")
+    if not workload_name:
+        return None
+    try:
+        from ..api.topology import TPUGen
+        from ..config import SchedulerConfig
+        from ..registry.client import Client as RegistryClient
+
+        rc = SchedulerConfig.from_env().registry
+        reg = RegistryClient(rc.host, rc.port, password=rc.password)
+        reg.ping()
+        chips = len([c for c in
+                     os.environ.get("TPU_VISIBLE_CHIPS", "").split(",")
+                     if c]) or n_devices
+        try:
+            gen = TPUGen(os.environ.get("TPU_ACCELERATOR_TYPE", "")).name
+        except ValueError:
+            gen = "V5E"
+        column = f"{chips}P_{gen}"
+        pod_name = os.environ.get("HOSTNAME", "")
+        env_neighbors = os.environ.get("TPU_NEIGHBORS", "")
+
+        def publish(qps: float) -> None:
+            publish_observation(
+                reg, workload_name, column, qps,
+                neighbors=current_neighbors(reg, pod_name, env_neighbors))
+
+        return publish
+    except Exception as e:  # noqa: BLE001 — observability never kills work
+        log.warning("observation publishing disabled: %s", e)
+        return None
+
+
 def current_neighbors(registry, pod_name: str, env_value: str = "") -> List[str]:
     """The LIVE neighbor list for a pod: the scheduler refreshes
     ``neighbors/<pod>`` at every bind that changes the pod's partition

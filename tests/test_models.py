@@ -483,3 +483,22 @@ class TestMoEServing:
             for i, rid in enumerate(ids):
                 assert done[rid] == [int(t) for t in ref[i]], (
                     bucket, i, done[rid])
+
+
+class TestMoEDroplessRoute:
+    def test_matches_capacity_path_when_no_drops(self):
+        """moe_ffn_dropless must equal moe_ffn at ample capacity — the two
+        formulations are the same function in the no-drop regime."""
+        from k8s_gpu_scheduler_tpu.ops.moe import moe_ffn, moe_ffn_dropless
+
+        key = jax.random.PRNGKey(0)
+        D, F, E = 32, 64, 4
+        x = jax.random.normal(key, (2, 8, D), jnp.float32)
+        router = jax.random.normal(jax.random.fold_in(key, 1), (D, E)) * 0.1
+        wg = jax.random.normal(jax.random.fold_in(key, 2), (E, D, F)) * 0.1
+        wu = jax.random.normal(jax.random.fold_in(key, 3), (E, D, F)) * 0.1
+        wd = jax.random.normal(jax.random.fold_in(key, 4), (E, F, D)) * 0.1
+        ref, _ = moe_ffn(x, router, wg, wu, wd, top_k=2,
+                         capacity_factor=float(E))
+        got = moe_ffn_dropless(x, router, wg, wu, wd, top_k=2)
+        assert float(jnp.abs(got - ref).max()) < 1e-4
