@@ -9,7 +9,7 @@ rebuild to treat as first-class.
 from .layers import apply_rope, rms_norm, rope_freqs, swiglu
 from .attention import dense_attention, ring_attention, ulysses_attention
 from .flash_attention import flash_attention, flash_attention_diff
-from .moe import load_balancing_loss, moe_ffn
+from .moe import load_balancing_loss, moe_ffn, moe_ffn_dropless
 
 __all__ = [
     "rms_norm",
@@ -22,5 +22,6 @@ __all__ = [
     "flash_attention",
     "flash_attention_diff",
     "moe_ffn",
+    "moe_ffn_dropless",
     "load_balancing_loss",
 ]
