@@ -254,7 +254,19 @@ def main():
         bench_schedule_churn(n_nodes=4, n_pods=8)
     except Exception:  # noqa: BLE001
         pass
-    churn = bench_schedule_churn()
+    # Headline leg is MEDIAN-of-3 by p50: sub-2ms medians are at the mercy
+    # of GC pauses and background threads. The median is noise-robust
+    # without biasing the headline favorably (min-of-N would), and every
+    # trial's p50 is emitted so run-to-run variance stays visible.
+    trials = [bench_schedule_churn()]
+    for _ in range(2):
+        try:
+            trials.append(bench_schedule_churn())
+        except Exception:  # noqa: BLE001
+            break
+    trials.sort(key=lambda t: t["p50_ms"])
+    churn = dict(trials[len(trials) // 2])
+    churn["p50_trials_ms"] = [t["p50_ms"] for t in trials]
     try:
         churn_rest = bench_schedule_churn(rest=True)
     except Exception as e:  # noqa: BLE001 — REST leg must not kill the line
