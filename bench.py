@@ -264,9 +264,10 @@ def main():
             trials.append(bench_schedule_churn())
         except Exception:  # noqa: BLE001
             break
+    run_order = [t["p50_ms"] for t in trials]        # before sorting: drift visible
     trials.sort(key=lambda t: t["p50_ms"])
     churn = dict(trials[len(trials) // 2])
-    churn["p50_trials_ms"] = [t["p50_ms"] for t in trials]
+    churn["p50_trials_ms"] = run_order
     try:
         churn_rest = bench_schedule_churn(rest=True)
     except Exception as e:  # noqa: BLE001 — REST leg must not kill the line

@@ -239,6 +239,55 @@ class TestContinuousBatching:
             ref = generate(params, p[None, :], self.cfg, max_new=5, max_len=32)
             assert done[rid] == [int(t) for t in ref[0]], rid
 
+    def test_same_step_slot_reuse_in_one_batched_prefill(self):
+        """A max_new==1 request frees its slot DURING admission, so a later
+        request reuses it within the same step — both ride the one batched
+        prefill dispatch. The pad rows duplicate the LAST admission
+        (serving.py step): padding with an earlier one would re-apply the
+        freed slot's superseded writes after the reuser's and corrupt its
+        cache window."""
+        from k8s_gpu_scheduler_tpu.models import generate
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        key = jax.random.PRNGKey(7)
+        prompts = [jax.random.randint(jax.random.fold_in(key, i), (4,), 0,
+                                      self.cfg.vocab) for i in range(2)]
+        eng = ContinuousBatcher(params, self.cfg, n_slots=3, max_len=32,
+                                chunk=2, prefill_bucket=4)
+        one_id = eng.submit(prompts[0], max_new=1)     # slot freed mid-step
+        long_id = eng.submit(prompts[1], max_new=4)    # may reuse that slot
+        done = eng.run()
+        for p, rid, budget in [(prompts[0], one_id, 1),
+                               (prompts[1], long_id, 4)]:
+            ref = generate(params, p[None, :], self.cfg, max_new=budget,
+                           max_len=32)
+            assert done[rid] == [int(t) for t in ref[0]], rid
+
+    def test_short_request_burst_admits_at_most_n_slots_per_step(self):
+        """max_new==1 admissions free their slot immediately; without the
+        per-step cap a burst would grow the prefill batch M past n_slots
+        and recompile the prefill program per distinct burst size."""
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        key = jax.random.PRNGKey(11)
+        prompts = [jax.random.randint(jax.random.fold_in(key, i), (4,), 0,
+                                      self.cfg.vocab) for i in range(5)]
+        eng = ContinuousBatcher(params, self.cfg, n_slots=2, max_len=32,
+                                chunk=2, prefill_bucket=4)
+        seen_m = set()
+        orig = eng._prefill
+        def spy(p, k, v, bm, rp, last, slots, curs, tokens, real_lens):
+            seen_m.add(tokens.shape[0])
+            return orig(p, k, v, bm, rp, last, slots, curs, tokens, real_lens)
+        eng._prefill = spy
+        ids = [eng.submit(p, max_new=1) for p in prompts]
+        done = eng.run()
+        assert set(done) == set(ids)
+        assert all(len(done[r]) == 1 for r in ids)
+        assert seen_m == {eng.n_slots}, seen_m    # one compiled shape only
+
     def test_midstream_admission_reuses_freed_slot(self):
         """More requests than slots with unequal budgets: a short request
         finishes, its slot admits a queued request while the long request
