@@ -193,6 +193,8 @@ class TPUPlugin(
             self._cm_lister = None
         # node -> (raw registry value, parsed inventory); see _inventory.
         self._inv_parse_cache: Dict[str, Tuple[str, Optional[NodeInventory]]] = {}
+        # (dims, gen, config-annotation) -> carved Partition list (read-only).
+        self._carve_cache: Dict[Tuple, List[Partition]] = {}
         # pod uid -> (node, partition key) recorded at Reserve; bridges the
         # Reserve -> ConfigMap-visible-in-lister window (see reserve()).
         self._assigned_memo: Dict[str, Tuple[str, str]] = {}
@@ -651,27 +653,40 @@ class TPUPlugin(
         from ..api.objects import ANN_SLICE_CONFIG
         from ..api.topology import format_topology, host_board
 
+        cfg = info.node.metadata.annotations.get(ANN_SLICE_CONFIG, "")
+        # The carve is a pure function of (board, config annotation) and
+        # Partition objects are read-only after construction — memoized so
+        # Score at fleet scale doesn't rebuild identical lists per node per
+        # cycle (it was a top allocation site in the 256-node profile).
+        memo_key = (topo.dims, topo.gen, cfg)
+        cached = self._carve_cache.get(memo_key)
+        if cached is not None:
+            return cached
         board = host_board(topo.dims, topo.gen)
         total = chip_count(board)
-        cfg = info.node.metadata.annotations.get(ANN_SLICE_CONFIG, "")
         if cfg:
             try:
                 per = chip_count(parse_topology(cfg))
             except ValueError:
                 per = total
+            shown = cfg
         else:
-            cfg = format_topology(board)
+            shown = format_topology(board)
             per = total
         per = max(1, min(per, total))
         count = total // per
-        return [
+        parts = [
             Partition(
-                key=f"part-{i}/{cfg}",
-                topology=cfg,
+                key=f"part-{i}/{shown}",
+                topology=shown,
                 chip_ids=list(range(i * per, (i + 1) * per)),
             )
             for i in range(count)
         ]
+        if len(self._carve_cache) > 1024:
+            self._carve_cache.clear()
+        self._carve_cache[memo_key] = parts
+        return parts
 
     def residents_by_partition(
         self, info: NodeInfo, partitions: List[Partition]
@@ -687,12 +702,15 @@ class TPUPlugin(
         fallback = partitions[0].key if partitions else ""
         out: Dict[str, List[Pod]] = {p.key: [] for p in partitions}
         cm_cache: Dict[Tuple[str, str], object] = {}
+        # Per-resident .get()s under the lock, NOT a dict copy: the memo
+        # holds up to 4096 entries and this runs once per Score call — the
+        # copy dominated the 256-node cycle profile.
         with self._assign_mu:
-            memo = dict(self._assigned_memo)
-        for p in info.pods:
-            if p.spec.tpu_chips() == 0:
-                continue
-            held = memo.get(p.metadata.uid)
+            held_by_uid = [
+                (p, self._assigned_memo.get(p.metadata.uid))
+                for p in info.pods if p.spec.tpu_chips() > 0
+            ]
+        for p, held in held_by_uid:
             if held is not None and held[0] == info.name and held[1] in out:
                 key = held[1]
             else:

@@ -28,6 +28,10 @@ class NodeInfo:
     node: Node
     pods: List[Pod] = field(default_factory=list)
     requested_tpu: int = 0
+    # Bumped by the Cache on every mutation of this node — lets snapshot()
+    # reuse unchanged per-node copies across cycles (kube-scheduler's
+    # nodeInfo.Generation / cache.UpdateSnapshot design).
+    generation: int = 0
     # ((accelerator, topology), parsed) memo — see slice_topology().
     _topo_cache: Optional[tuple] = field(default=None, repr=False, compare=False)
 
@@ -62,7 +66,13 @@ class NodeInfo:
         return parsed
 
     def shallow_copy(self) -> "NodeInfo":
-        return NodeInfo(node=self.node, pods=list(self.pods), requested_tpu=self.requested_tpu)
+        c = NodeInfo(node=self.node, pods=list(self.pods),
+                     requested_tpu=self.requested_tpu,
+                     generation=self.generation)
+        # Carry the topology memo: labels rarely change and the memo is
+        # keyed on their values, so a stale carry self-invalidates.
+        c._topo_cache = self._topo_cache
+        return c
 
 
 class Cache:
@@ -83,15 +93,26 @@ class Cache:
         self._nodes: Dict[str, NodeInfo] = {}
         # uid -> (pod, node_name) reserved in-flight
         self._assumed: Dict[str, tuple] = {}
+        # Monotonic mutation counter + per-node snapshot copies keyed by the
+        # generation they were taken at: snapshot() re-copies only nodes
+        # that changed since the last cycle (O(churn), not O(fleet)).
+        self._gen = 0
+        self._snap: Dict[str, NodeInfo] = {}
+
+    def _touch_locked(self, info: NodeInfo) -> None:
+        self._gen += 1
+        info.generation = self._gen
 
     # -- node events -------------------------------------------------------
     def add_node(self, node: Node) -> None:
         with self._mu:
             info = self._nodes.get(node.metadata.name)
             if info is None:
-                self._nodes[node.metadata.name] = NodeInfo(node=node)
+                info = NodeInfo(node=node)
+                self._nodes[node.metadata.name] = info
             else:
                 info.node = node
+            self._touch_locked(info)
 
     def update_node(self, _old: Optional[Node], new: Node) -> None:
         self.add_node(new)
@@ -169,9 +190,22 @@ class Cache:
     # -- snapshot ----------------------------------------------------------
     def snapshot(self) -> Dict[str, NodeInfo]:
         """Copy-on-read view for one scheduling cycle (kube-scheduler's
-        Snapshot().NodeInfos(), used by the reference at gpu_plugins.go:798)."""
+        Snapshot().NodeInfos(), used by the reference at gpu_plugins.go:798).
+
+        Incremental: per-node copies are reused until that node's
+        generation changes (kube's cache.UpdateSnapshot). A cycle holding
+        last cycle's dict keeps reading its own consistent copies — the
+        cache only ever REPLACES entries here, never mutates them."""
         with self._mu:
-            return {name: info.shallow_copy() for name, info in self._nodes.items()}
+            snap = self._snap
+            for name, info in self._nodes.items():
+                prev = snap.get(name)
+                if prev is None or prev.generation != info.generation:
+                    snap[name] = info.shallow_copy()
+            if len(snap) != len(self._nodes):
+                for name in [n for n in snap if n not in self._nodes]:
+                    del snap[name]
+            return dict(snap)
 
     def node_names(self) -> List[str]:
         with self._mu:
@@ -192,9 +226,11 @@ class Cache:
         for i, p in enumerate(info.pods):
             if p.metadata.uid == pod.metadata.uid:
                 info.pods[i] = pod  # already accounted — refresh only
+                self._touch_locked(info)
                 return
         info.pods.append(pod)
         info.requested_tpu += pod.spec.tpu_chips()
+        self._touch_locked(info)
 
     def _remove_locked(self, node_name: str, pod: Pod) -> None:
         info = self._nodes.get(node_name)
@@ -204,6 +240,7 @@ class Cache:
             if p.metadata.uid == pod.metadata.uid:
                 del info.pods[i]
                 info.requested_tpu -= p.spec.tpu_chips()
+                self._touch_locked(info)
                 return
         # not present — already credited; no-op
 
@@ -217,4 +254,5 @@ class Cache:
         for i, p in enumerate(info.pods):
             if p.metadata.uid == pod.metadata.uid:
                 info.pods[i] = pod
+                self._touch_locked(info)
                 return
