@@ -180,18 +180,36 @@ def make_server_step(cfg: LlamaConfig, mesh: Optional[Mesh], max_new: int,
 # steady-state cost is one idle boundary per ~S decode steps.
 
 
+def _sample_tokens(logits, key, temperature: float, top_k: int):
+    """Next-token choice from [..., vocab] logits: greedy argmax when
+    temperature == 0 (both are compile-time constants), else temperature/
+    top-k categorical sampling — each batch row draws independently from
+    the one key."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, _NEG_INF, logits)
+    return jax.random.categorical(key, logits)
+
+
 def _decode_chunk_fn(params, cfg: LlamaConfig, chunk: int,
                      mesh: Optional[Mesh], k, v, bitmap, cursor, rope_pos,
-                     last, active):
+                     last, active, seed, temperature: float = 0.0,
+                     top_k: int = 0):
     """Advance every active slot ``chunk`` tokens; inactive slots carry
     through (their cache row at the cursor is written with garbage but
-    never marked valid). Returns the emitted tokens [B, chunk]."""
+    never marked valid). Returns the emitted tokens [B, chunk]. ``seed``
+    (traced) is the engine's dispatch counter — sampling keys derive from
+    it on device, so no PRNG state rides the tunnel."""
     B = last.shape[0]
     S = k.shape[2]
     angles_full = rope_freqs(cfg.head_dim, S, cfg.rope_theta)
     col = jnp.arange(S)[None, :]
+    base_key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
 
-    def one_token(carry, _):
+    def one_token(carry, tick):
         k, v, bitmap, cursor, rope_pos, last = carry
         # Mark the row being written valid for active slots BEFORE
         # attention — the new token attends itself.
@@ -228,20 +246,24 @@ def _decode_chunk_fn(params, cfg: LlamaConfig, chunk: int,
         v = _constrain(v, mesh, CACHE_SPEC)
         x = rms_norm(x, params["final_norm"])
         logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
-        nxt = jnp.argmax(logits, axis=-1).astype(last.dtype)
+        nxt = _sample_tokens(
+            logits, jax.random.fold_in(base_key, tick), temperature, top_k
+        ).astype(last.dtype)
         emitted = jnp.where(active, nxt, -1)
         last = jnp.where(active, nxt, last)
         rope_pos = rope_pos + active.astype(rope_pos.dtype)
         return (k, v, bitmap, cursor + 1, rope_pos, last), emitted
 
     (k, v, bitmap, cursor, rope_pos, last), toks = jax.lax.scan(
-        one_token, (k, v, bitmap, cursor, rope_pos, last), None, length=chunk)
+        one_token, (k, v, bitmap, cursor, rope_pos, last),
+        jnp.arange(chunk))
     return k, v, bitmap, cursor, rope_pos, last, jnp.swapaxes(toks, 0, 1)
 
 
 def _prefill_multi_fn(params, cfg: LlamaConfig, mesh: Optional[Mesh],
                       k, v, bitmap, rope_pos, last, slots, cursors, tokens,
-                      real_lens):
+                      real_lens, seed, temperature: float = 0.0,
+                      top_k: int = 0):
     """Prefill M freed slots from right-padded prompts [M, tb] in ONE
     dispatch: compute every prompt's K/V in a self-contained batched mini
     cache (rope from 0), then write each entry's tb rows into its slot's
@@ -275,6 +297,7 @@ def _prefill_multi_fn(params, cfg: LlamaConfig, mesh: Optional[Mesh],
     logits, mini = forward_with_cache(params, tokens, cfg, mini, mesh=None)
     col = jnp.arange(S)
     row_ids = jnp.arange(B)
+    base_key = jax.random.fold_in(jax.random.PRNGKey(1), seed)
     firsts = []
     for i in range(M):                               # static unroll
         slot, cursor, real_len = slots[i], cursors[i], real_lens[i]
@@ -286,7 +309,13 @@ def _prefill_multi_fn(params, cfg: LlamaConfig, mesh: Optional[Mesh],
         is_slot = (row_ids == slot)[:, None]
         rows = (col >= start) & (col < cursor)
         bitmap = jnp.where(is_slot, rows[None, :], bitmap)
-        first = jnp.argmax(logits[i, real_len - 1], axis=-1).astype(last.dtype)
+        # Key by SLOT, not loop index: pad rows duplicate a real entry and
+        # must re-draw the SAME token, or the duplicate's write would
+        # overwrite `last` with a different sample (argmax never cared).
+        first = _sample_tokens(
+            logits[i, real_len - 1], jax.random.fold_in(base_key, slot),
+            temperature, top_k,
+        ).astype(last.dtype)
         rope_pos = jnp.where(is_slot[:, 0], real_len, rope_pos)
         last = jnp.where(is_slot[:, 0], first, last)
         firsts.append(first)
@@ -304,12 +333,32 @@ class ContinuousBatcher:
 
     def __init__(self, params, cfg: LlamaConfig, n_slots: int = 8,
                  max_len: Optional[int] = None, chunk: int = 8,
-                 prefill_bucket: int = 128, mesh: Optional[Mesh] = None):
+                 prefill_bucket: int = 128, mesh: Optional[Mesh] = None,
+                 eos_id: Optional[int] = None, temperature: float = 0.0,
+                 top_k: int = 0):
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
         self.chunk = chunk
         self.bucket = prefill_bucket
+        # eos_id: a request finishes at its first eos token (output is
+        # truncated INCLUDING the eos) or at max_new, whichever first. EOS
+        # makes completion content-dependent, so run() flushes per step
+        # instead of deferring every readback to the drain (one tunnel
+        # round trip per chunk instead of per drain — the price of early
+        # stopping; max_new-only workloads keep the fast path).
+        # temperature/top_k: 0 = greedy argmax (compiled out); >0 =
+        # temperature/top-k categorical sampling, seeded per dispatch from
+        # a device-side counter fold (no PRNG state crosses the tunnel).
+        self.eos_id = eos_id
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        if self.top_k > cfg.vocab:
+            # Caught here, where the other params are validated — inside
+            # jit, lax.top_k fails at trace time with an obscure shape error.
+            raise ValueError(f"top_k {self.top_k} exceeds vocab {cfg.vocab}")
+        self._dispatch_no = 0
+        self._eos_scanned: Dict[int, int] = {}       # req id -> tokens scanned
         self.S = min(max_len or cfg.max_seq, cfg.max_seq)
         cache = init_cache(cfg, n_slots, self.S)
         self._k, self._v = cache["k"], cache["v"]
@@ -328,15 +377,17 @@ class ContinuousBatcher:
         # partial would inline every weight into the compiled program as a
         # constant. Caches/bitmap are donated: each dispatch consumes and
         # replaces them; without donation every call holds two full copies.
+        temp, tk = self.temperature, self.top_k
         self._decode = jax.jit(
-            lambda p, k, v, bm, cur, rp, last, active: _decode_chunk_fn(
-                p, cfg, chunk, mesh, k, v, bm, cur, rp, last, active),
+            lambda p, k, v, bm, cur, rp, last, active, seed: _decode_chunk_fn(
+                p, cfg, chunk, mesh, k, v, bm, cur, rp, last, active, seed,
+                temp, tk),
             donate_argnums=(1, 2, 3),
         )
         self._prefill = jax.jit(
-            lambda p, k, v, bm, rp, last, slots, curs, tokens, real_lens:
-            _prefill_multi_fn(p, cfg, mesh, k, v, bm, rp, last, slots, curs,
-                              tokens, real_lens),
+            lambda p, k, v, bm, rp, last, slots, curs, tokens, real_lens,
+            seed: _prefill_multi_fn(p, cfg, mesh, k, v, bm, rp, last, slots,
+                                    curs, tokens, real_lens, seed, temp, tk),
             donate_argnums=(1, 2, 3),
         )
 
@@ -437,6 +488,7 @@ class ContinuousBatcher:
             tokens = np.asarray(
                 [p + [0] * (self.bucket - len(p)) for _, _, _, p in rows],
                 np.int32)
+            self._dispatch_no += 1
             (self._k, self._v, self._bitmap, self._rope_pos, self._last,
              firsts_arr) = self._prefill(
                 self.params, self._k, self._v, self._bitmap, self._rope_pos,
@@ -444,10 +496,12 @@ class ContinuousBatcher:
                 np.asarray([s for _, s, _, _ in rows], np.int32),
                 np.asarray([c for _, _, c, _ in rows], np.int32),
                 tokens,
-                np.asarray([len(p) for _, _, _, p in rows], np.int32))
-            # Prefill already produced each request's FIRST token (greedy
-            # argmax at the prompt's last position — the same token the
-            # static generate path emits first).
+                np.asarray([len(p) for _, _, _, p in rows], np.int32),
+                np.int32(self._dispatch_no))
+            # Prefill already produced each request's FIRST token from the
+            # prompt's last-position logits (greedy argmax when
+            # temperature == 0 — matching the static generate path — else
+            # a slot-keyed categorical sample).
             self._reads.append(
                 ("firsts", firsts_arr, [rid for rid, _, _, _ in adm]))
 
@@ -455,10 +509,12 @@ class ContinuousBatcher:
             return finished
         active = np.asarray(
             [s in self._slot_req for s in range(self.n_slots)])
+        self._dispatch_no += 1
         (self._k, self._v, self._bitmap, cursor, self._rope_pos, self._last,
          toks) = self._decode(
             self.params, self._k, self._v, self._bitmap,
-            np.int32(self._cursor), self._rope_pos, self._last, active)
+            np.int32(self._cursor), self._rope_pos, self._last, active,
+            np.int32(self._dispatch_no))
         self._cursor += self.chunk
 
         takes: list = []                             # (req id, slot, n tokens)
@@ -489,18 +545,60 @@ class ContinuousBatcher:
                     self._out[req_id].extend(int(t) for t in vals[slot, :take])
         self._reads = []
 
+    def _reap_eos(self) -> list:
+        """Free slots whose flushed output now contains eos — the request
+        is done regardless of remaining budget. Only tokens appended since
+        the last reap are scanned (a per-request offset), so a long
+        generation costs O(tokens) total, not O(tokens²). Row-space note:
+        the freed slot's stale cache rows are exactly the normal-finish
+        leftovers; the next admission rewrites its bitmap window over
+        them."""
+        reaped: list = []
+        for slot, req_id in list(self._slot_req.items()):
+            out = self._out[req_id]
+            seen = self._eos_scanned.get(req_id, 0)
+            if self.eos_id in out[seen:]:
+                del self._slot_req[slot]
+                del self._budget[req_id]
+                self._eos_scanned.pop(req_id, None)
+                reaped.append(req_id)
+            else:
+                self._eos_scanned[req_id] = len(out)
+        return reaped
+
+    def _truncate_eos(self, toks: list) -> list:
+        if self.eos_id is None:
+            return toks
+        try:
+            return toks[: toks.index(self.eos_id) + 1]
+        except ValueError:
+            return toks
+
     def step(self) -> Dict[int, list]:
         """Admit into free slots, decode one chunk, return newly finished
         {req id: decoded tokens}."""
         finished = self._step_lazy()
         self._flush()
-        return {rid: self._out.pop(rid) for rid in finished}
+        if self.eos_id is not None:
+            finished.extend(self._reap_eos())
+            for rid in finished:                     # budget-finished leak
+                self._eos_scanned.pop(rid, None)
+        return {rid: self._truncate_eos(self._out.pop(rid))
+                for rid in finished}
 
     def run(self) -> Dict[int, list]:
-        """Drain everything submitted; returns {req id: tokens}. All
-        chunks dispatch back-to-back asynchronously (scheduling never
-        depends on token values) and the results come back in one
-        readback."""
+        """Drain everything submitted; returns {req id: tokens}.
+
+        Without an eos_id, scheduling never depends on token values: all
+        chunks dispatch back-to-back asynchronously and the results come
+        back in one readback. With eos_id set, completion IS
+        content-dependent, so each step flushes before the next admission
+        decision (step())."""
+        if self.eos_id is not None:
+            done: Dict[int, list] = {}
+            while self.pending:
+                done.update(self.step())
+            return done
         finished: list = []
         while self.pending:
             finished.extend(self._step_lazy())

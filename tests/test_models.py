@@ -278,15 +278,69 @@ class TestContinuousBatching:
                                 chunk=2, prefill_bucket=4)
         seen_m = set()
         orig = eng._prefill
-        def spy(p, k, v, bm, rp, last, slots, curs, tokens, real_lens):
+        def spy(p, k, v, bm, rp, last, slots, curs, tokens, real_lens, seed):
             seen_m.add(tokens.shape[0])
-            return orig(p, k, v, bm, rp, last, slots, curs, tokens, real_lens)
+            return orig(p, k, v, bm, rp, last, slots, curs, tokens,
+                        real_lens, seed)
         eng._prefill = spy
         ids = [eng.submit(p, max_new=1) for p in prompts]
         done = eng.run()
         assert set(done) == set(ids)
         assert all(len(done[r]) == 1 for r in ids)
         assert seen_m == {eng.n_slots}, seen_m    # one compiled shape only
+
+    def test_eos_stops_early_and_frees_the_slot(self):
+        """eos_id finishes a request at its first eos (inclusive) before
+        the budget runs out, and the freed slot admits queued work. The
+        eos token is taken from a greedy run so the model genuinely emits
+        it mid-stream."""
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        prompt = jax.random.randint(jax.random.PRNGKey(5), (4,), 0,
+                                    self.cfg.vocab)
+        ref_eng = ContinuousBatcher(params, self.cfg, n_slots=1, max_len=32,
+                                    chunk=2, prefill_bucket=4)
+        rid = ref_eng.submit(prompt, max_new=8)
+        ref = ref_eng.run()[rid]
+        eos = ref[2]                                  # emitted by step 3
+        want = ref[: ref.index(eos) + 1]              # ...at its FIRST occurrence
+        assert len(want) < len(ref)                   # genuinely early
+
+        eng = ContinuousBatcher(params, self.cfg, n_slots=1, max_len=32,
+                                chunk=2, prefill_bucket=4, eos_id=eos)
+        a = eng.submit(prompt, max_new=8)
+        b = eng.submit(prompt, max_new=8)             # queued behind a
+        done = eng.run()
+        assert done[a] == want, (done[a], want)       # truncated incl. eos
+        assert done[b] == want                        # same prompt, greedy
+        assert eng.pending == 0
+
+    def test_sampling_topk1_matches_greedy_and_is_reproducible(self):
+        """temperature>0 with top_k=1 must reproduce greedy argmax (the
+        categorical collapses to the single surviving logit), and a fresh
+        engine with the same seed path must replay the identical stream;
+        unconstrained high-temperature sampling must diverge from greedy
+        somewhere."""
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        prompt = jax.random.randint(jax.random.PRNGKey(6), (4,), 0,
+                                    self.cfg.vocab)
+
+        def run_engine(**kw):
+            eng = ContinuousBatcher(params, self.cfg, n_slots=2, max_len=32,
+                                    chunk=2, prefill_bucket=4, **kw)
+            rid = eng.submit(prompt, max_new=8)
+            return eng.run()[rid]
+
+        greedy = run_engine()
+        topk1 = run_engine(temperature=1.0, top_k=1)
+        assert topk1 == greedy, (topk1, greedy)
+        hot_a = run_engine(temperature=5.0)
+        hot_b = run_engine(temperature=5.0)
+        assert hot_a == hot_b                          # deterministic seed path
+        assert hot_a != greedy                         # actually sampling
 
     def test_midstream_admission_reuses_freed_slot(self):
         """More requests than slots with unequal budgets: a short request
