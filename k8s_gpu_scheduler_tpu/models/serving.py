@@ -32,6 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import _repeat_kv
 from ..ops.layers import apply_rope, rms_norm, rope_freqs
+from ..ops.quant import qdot
 from .llama import LlamaConfig, _constrain, mlp_sublayer
 
 _NEG_INF = -1e30
@@ -94,14 +95,14 @@ def forward_with_cache(
     def block(x, layer):
         blk, k_cache, v_cache = layer
         h = rms_norm(x, blk["attn_norm"])
-        q = (h @ blk["wq"]).reshape(B, t, cfg.n_heads, cfg.head_dim)
-        k = (h @ blk["wk"]).reshape(B, t, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ blk["wv"]).reshape(B, t, cfg.n_kv_heads, cfg.head_dim)
+        q = qdot(h, blk["wq"]).reshape(B, t, cfg.n_heads, cfg.head_dim)
+        k = qdot(h, blk["wk"]).reshape(B, t, cfg.n_kv_heads, cfg.head_dim)
+        v = qdot(h, blk["wv"]).reshape(B, t, cfg.n_kv_heads, cfg.head_dim)
         q, k = apply_rope(q, angles), apply_rope(k, angles)
         k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
         v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
         attn = cached_attention(q, k_cache, v_cache, pos)
-        x = x + attn.reshape(B, t, cfg.n_heads * cfg.head_dim) @ blk["wo"]
+        x = x + qdot(attn.reshape(B, t, cfg.n_heads * cfg.head_dim), blk["wo"])
         x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
         return x, (k_cache, v_cache)
 
@@ -110,7 +111,7 @@ def forward_with_cache(
     k_new = _constrain(k_new, mesh, CACHE_SPEC)
     v_new = _constrain(v_new, mesh, CACHE_SPEC)
     x = rms_norm(x, params["final_norm"])
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = qdot(x, params["lm_head"]).astype(jnp.float32)
     return logits, {"k": k_new, "v": v_new, "len": pos + t}
 
 
@@ -221,9 +222,9 @@ def _decode_chunk_fn(params, cfg: LlamaConfig, chunk: int,
         def block(x, layer):
             blk, k_cache, v_cache = layer                      # [B,S,Hkv,hd]
             h = rms_norm(x, blk["attn_norm"])
-            q = (h @ blk["wq"]).reshape(B, 1, cfg.n_heads, cfg.head_dim)
-            kk = (h @ blk["wk"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
-            vv = (h @ blk["wv"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
+            q = qdot(h, blk["wq"]).reshape(B, 1, cfg.n_heads, cfg.head_dim)
+            kk = qdot(h, blk["wk"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
+            vv = qdot(h, blk["wv"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
             q, kk = apply_rope(q, angles), apply_rope(kk, angles)
             k_cache = jax.lax.dynamic_update_slice_in_dim(
                 k_cache, kk, cursor, axis=1)
@@ -237,7 +238,8 @@ def _decode_chunk_fn(params, cfg: LlamaConfig, chunk: int,
             scores = jnp.where(kmask, scores, _NEG_INF)
             probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
             attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
-            x = x + attn.reshape(B, 1, cfg.n_heads * cfg.head_dim) @ blk["wo"]
+            x = x + qdot(attn.reshape(B, 1, cfg.n_heads * cfg.head_dim),
+                         blk["wo"])
             x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
             return x, (k_cache, v_cache)
 
@@ -245,7 +247,7 @@ def _decode_chunk_fn(params, cfg: LlamaConfig, chunk: int,
         k = _constrain(k, mesh, CACHE_SPEC)
         v = _constrain(v, mesh, CACHE_SPEC)
         x = rms_norm(x, params["final_norm"])
-        logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+        logits = qdot(x[:, 0], params["lm_head"]).astype(jnp.float32)
         nxt = _sample_tokens(
             logits, jax.random.fold_in(base_key, tick), temperature, top_k
         ).astype(last.dtype)

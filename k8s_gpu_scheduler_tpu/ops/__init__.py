@@ -10,8 +10,13 @@ from .layers import apply_rope, rms_norm, rope_freqs, swiglu
 from .attention import dense_attention, ring_attention, ulysses_attention
 from .flash_attention import flash_attention, flash_attention_diff
 from .moe import load_balancing_loss, moe_ffn, moe_ffn_dropless
+from .quant import dequantize_weight, qdot, quantize_llama_params, quantize_weight
 
 __all__ = [
+    "qdot",
+    "quantize_weight",
+    "dequantize_weight",
+    "quantize_llama_params",
     "rms_norm",
     "rope_freqs",
     "apply_rope",

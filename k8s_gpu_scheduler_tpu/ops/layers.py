@@ -10,6 +10,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .quant import qdot
+
 
 def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
     dtype = x.dtype
@@ -34,9 +36,10 @@ def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array) -> jax.Array:
+def swiglu(x: jax.Array, w_gate, w_up, w_down) -> jax.Array:
     """SwiGLU MLP: down( silu(x@gate) * (x@up) ). Three matmuls — the
     gate/up pair is column-parallel under tp, down row-parallel
-    (parallel/sharding.py conventions)."""
-    g = jax.nn.silu(x @ w_gate)
-    return (g * (x @ w_up)) @ w_down
+    (parallel/sharding.py conventions). Weights may be plain arrays or
+    int8 {"q","s"} leaves (ops/quant.py) — qdot passes plain ones through."""
+    g = jax.nn.silu(qdot(x, w_gate))
+    return qdot(g * qdot(x, w_up), w_down)

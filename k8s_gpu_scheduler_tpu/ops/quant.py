@@ -1,0 +1,70 @@
+"""Weight-only int8 quantization for serving.
+
+Decode is HBM-bandwidth-bound: each emitted token re-reads every matmul
+weight while the activations are a [B, 1, D] sliver, so halving the weight
+bytes halves the dominant memory traffic (the MXU is idle either way —
+maxtext and vLLM-TPU ship the same weight-only int8 mode for this reason).
+The reference has no serving engine at all (SURVEY.md §0); this extends
+BASELINE config 5's workload side.
+
+Scheme: symmetric per-output-channel int8. For a weight ``w [..., K, N]``
+(K = contraction dim), ``s = max|w| / 127`` over K gives ``s [..., 1, N]``
+and ``q = round(w / s)``; by linearity ``(x @ q) * s == x @ (q * s)``, so
+``qdot`` applies the scale AFTER the matmul — XLA fuses the int8→bf16
+convert into the dot's weight read and the HBM transfer stays int8.
+
+Quantized leaves are ``{"q": int8, "s": float}`` dicts, which ride
+``lax.scan`` over layer-stacked blocks like any other pytree. ``qdot``
+passes plain arrays through untouched, so shared call sites (swiglu, the
+serving blocks) serve both precisions with one code path and training is
+unaffected.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+# Leaves of params["blocks"] / top-level params that hold matmul weights —
+# everything else (norms, embed gather, f32 router) stays in model dtype.
+_BLOCK_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_weight(w: jax.Array) -> Dict[str, jax.Array]:
+    """w [..., K, N] → {"q": int8, "s": f32 [..., 1, N]} per-output-channel
+    symmetric; exact for the all-zero channel (scale floored)."""
+    s = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def dequantize_weight(wq: Dict[str, jax.Array], dtype) -> jax.Array:
+    return (wq["q"].astype(jnp.float32) * wq["s"]).astype(dtype)
+
+
+def qdot(x: jax.Array, w) -> jax.Array:
+    """x @ w for a plain array OR a quantized {"q","s"} leaf. The int8
+    operand converts to x.dtype inside the dot (fused weight-read convert);
+    the per-channel scale applies to the [..., N] result."""
+    if isinstance(w, dict):
+        y = x @ w["q"].astype(x.dtype)
+        return (y.astype(jnp.float32) * w["s"]).astype(x.dtype)
+    return x @ w
+
+
+def quantize_llama_params(params: Dict, cfg) -> Dict:
+    """Quantize a Llama param tree's matmul weights for serving. Dense
+    blocks only — MoE expert tensors keep their dropless einsum path
+    (quantizing them is a follow-up, not silently skipped)."""
+    if getattr(cfg, "n_experts", 1) > 1:
+        raise ValueError("int8 serving supports dense blocks only (n_experts=1)")
+    blocks = dict(params["blocks"])
+    for name in _BLOCK_WEIGHTS:
+        if name in blocks:
+            blocks[name] = quantize_weight(blocks[name])
+    out = dict(params)
+    out["blocks"] = blocks
+    out["lm_head"] = quantize_weight(params["lm_head"])
+    return out
