@@ -368,6 +368,30 @@ class TestContinuousBatching:
         assert all(len(done[r]) == 1 for r in ids)
         assert seen_m == {eng.n_slots}, seen_m    # one compiled shape only
 
+    def test_sharded_batcher_matches_single_device_stream(self):
+        """ContinuousBatcher under a dp×fsdp×tp mesh (cache batch sharded
+        over (dp, fsdp), kv heads over tp — CACHE_SPEC) must emit the same
+        greedy streams as the mesh-less engine. n_slots divides dp·fsdp so
+        the cache's slot axis shards evenly."""
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+        from k8s_gpu_scheduler_tpu.parallel import MeshSpec, make_mesh
+
+        params = self._params()
+        key = jax.random.PRNGKey(9)
+        prompts = [jax.random.randint(jax.random.fold_in(key, i), (5,), 0,
+                                      self.cfg.vocab) for i in range(6)]
+
+        def run(mesh):
+            eng = ContinuousBatcher(params, self.cfg, n_slots=4, max_len=32,
+                                    chunk=2, prefill_bucket=8, mesh=mesh)
+            ids = [eng.submit(p, max_new=4) for p in prompts]
+            done = eng.run()
+            return [done[r] for r in ids]
+
+        plain = run(None)
+        sharded = run(make_mesh(MeshSpec.for_devices(8, fsdp=2, tp=2)))
+        assert sharded == plain, (sharded, plain)
+
     def test_eos_stops_early_and_frees_the_slot(self):
         """eos_id finishes a request at its first eos (inclusive) before
         the budget runs out, and the freed slot admits queued work. The
