@@ -265,7 +265,7 @@ def _bench_serving_int8():
     params = init_params(cfg, jax.random.PRNGKey(0))
     out = {}
     for label, p in (("bf16", params),
-                     ("int8", quantize_llama_params(params, cfg))):
+                     ("int8", quantize_llama_params(params))):
         rng = np.random.default_rng(0)
         eng = ContinuousBatcher(p, cfg, n_slots=8, max_len=512, chunk=64,
                                 prefill_bucket=128)

@@ -122,7 +122,12 @@ def moe_ffn_dropless(
     setting and no O(T²) dispatch tensors; the E/k-fold overcompute vs
     ideal routing is the price of staying gather-free (a per-token weight
     gather is only memory-feasible at t=1). Per-token function: output is
-    independent of co-batched tokens and padding."""
+    independent of co-batched tokens and padding.
+
+    Expert weights may be plain arrays or int8 {"q","s"} leaves
+    (ops/quant.py) — qeinsum passes plain ones through."""
+    from .quant import qeinsum
+
     logits = (x.astype(jnp.float32) @ router.astype(jnp.float32))  # [B,T,E]
     probs = jax.nn.softmax(logits, axis=-1)
     gate_vals, gate_idx = jax.lax.top_k(probs, top_k)              # [B,T,k]
@@ -132,9 +137,9 @@ def moe_ffn_dropless(
     weights = jnp.einsum(
         "btk,btke->bte", gate_vals,
         jax.nn.one_hot(gate_idx, router.shape[1], dtype=jnp.float32))
-    g = jax.nn.silu(jnp.einsum("btd,edf->betf", x, w_gate))
-    u = jnp.einsum("btd,edf->betf", x, w_up)
-    out_e = jnp.einsum("betf,efd->betd", g * u, w_down)            # [B,E,T,D]
+    g = jax.nn.silu(qeinsum("btd,edf->betf", x, w_gate))
+    u = qeinsum("btd,edf->betf", x, w_up)
+    out_e = qeinsum("betf,efd->betd", g * u, w_down)               # [B,E,T,D]
     return jnp.einsum("bte,betd->btd", weights.astype(x.dtype), out_e)
 
 

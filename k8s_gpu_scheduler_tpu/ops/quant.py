@@ -54,12 +54,25 @@ def qdot(x: jax.Array, w) -> jax.Array:
     return x @ w
 
 
-def quantize_llama_params(params: Dict, cfg) -> Dict:
-    """Quantize a Llama param tree's matmul weights for serving. Dense
-    blocks only — MoE expert tensors keep their dropless einsum path
-    (quantizing them is a follow-up, not silently skipped)."""
-    if getattr(cfg, "n_experts", 1) > 1:
-        raise ValueError("int8 serving supports dense blocks only (n_experts=1)")
+def qeinsum(spec: str, x: jax.Array, w) -> jax.Array:
+    """einsum(spec, x, w) for a plain array OR quantized leaf. Valid for
+    specs whose output keeps the weight's non-contracted dims as the
+    TRAILING axes in order (the MoE dispatch shapes "btd,edf->betf" /
+    "betf,efd->betd"), so the scale's [..., 1, N] broadcast lines up with
+    the result."""
+    if isinstance(w, dict):
+        y = jnp.einsum(spec, x, w["q"].astype(x.dtype))
+        return (y.astype(jnp.float32) * w["s"]).astype(x.dtype)
+    return jnp.einsum(spec, x, w)
+
+
+def quantize_llama_params(params: Dict) -> Dict:
+    """Quantize a Llama param tree's matmul weights for serving. Covers
+    dense AND MoE blocks: expert tensors ([L, E, D, F] etc.) quantize with
+    the same axis=-2 per-output-channel rule, giving per-(layer, expert,
+    channel) scales, and flow through qeinsum in the dropless serving
+    path. The f32 router is deliberately untouched (tiny, and expert
+    placement is precision-sensitive)."""
     blocks = dict(params["blocks"])
     for name in _BLOCK_WEIGHTS:
         if name in blocks:

@@ -220,7 +220,7 @@ class TestQuantizedServing:
         from k8s_gpu_scheduler_tpu.ops import dequantize_weight, quantize_llama_params
 
         params = init_params(self.cfg, jax.random.PRNGKey(0))
-        qparams = quantize_llama_params(params, self.cfg)
+        qparams = quantize_llama_params(params)
         deq = {
             **qparams,
             "blocks": {
@@ -243,7 +243,7 @@ class TestQuantizedServing:
         from k8s_gpu_scheduler_tpu.ops import quantize_llama_params
 
         params = init_params(self.cfg, jax.random.PRNGKey(0))
-        qparams = quantize_llama_params(params, self.cfg)
+        qparams = quantize_llama_params(params)
         prompt = jax.random.randint(jax.random.PRNGKey(2), (6,), 0,
                                     self.cfg.vocab)
 
@@ -260,17 +260,36 @@ class TestQuantizedServing:
         agree = sum(a == b for a, b in zip(fp, q8))
         assert agree >= 3, (fp, q8)
 
-    def test_moe_params_rejected(self):
-        import pytest
-
-        from k8s_gpu_scheduler_tpu.ops import quantize_llama_params
+    def test_moe_quantized_matches_dequantized_float_path(self):
+        """Expert weights ([L, E, D, F]) quantize per-(layer, expert,
+        channel) and flow through qeinsum in the dropless serving path;
+        the router stays f32. Same linearity check as the dense case."""
+        from k8s_gpu_scheduler_tpu.models import forward_with_cache, init_cache
+        from k8s_gpu_scheduler_tpu.ops import dequantize_weight, quantize_llama_params
 
         moe_cfg = LlamaConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
                               n_kv_heads=4, d_ff=64, max_seq=32,
                               dtype=jnp.float32, n_experts=4)
         params = init_params(moe_cfg, jax.random.PRNGKey(0))
-        with pytest.raises(ValueError):
-            quantize_llama_params(params, moe_cfg)
+        qparams = quantize_llama_params(params)
+        assert qparams["blocks"]["w_gate"]["s"].shape == (2, 4, 1, 64)
+        assert not isinstance(qparams["blocks"]["router"], dict)
+        deq = {
+            **qparams,
+            "blocks": {
+                k: (dequantize_weight(v, jnp.float32)
+                    if isinstance(v, dict) else v)
+                for k, v in qparams["blocks"].items()
+            },
+            "lm_head": dequantize_weight(qparams["lm_head"], jnp.float32),
+        }
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0,
+                                    moe_cfg.vocab)
+        ql, _ = forward_with_cache(qparams, tokens, moe_cfg,
+                                   init_cache(moe_cfg, 2, 32))
+        dl, _ = forward_with_cache(deq, tokens, moe_cfg,
+                                   init_cache(moe_cfg, 2, 32))
+        assert jnp.allclose(ql, dl, atol=1e-4), float(jnp.abs(ql - dl).max())
 
 
 class TestContinuousBatching:
