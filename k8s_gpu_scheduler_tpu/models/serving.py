@@ -342,6 +342,8 @@ class ContinuousBatcher:
         self.cfg = cfg
         self.n_slots = n_slots
         self.chunk = chunk
+        if prefill_bucket < 1:
+            raise ValueError(f"prefill_bucket must be >= 1, got {prefill_bucket}")
         self.bucket = prefill_bucket
         # eos_id: a request finishes at its first eos token (output is
         # truncated INCLUDING the eos) or at max_new, whichever first. EOS
@@ -394,16 +396,36 @@ class ContinuousBatcher:
         )
 
     # -- API ---------------------------------------------------------------
+    def _ladder(self, prompt_len: int) -> int:
+        """Prefill bucket for a prompt: the base bucket doubled until it
+        fits, clamped to the cache capacity (one compiled prefill program
+        per rung actually used, so long prompts up to the cache capacity
+        are accepted without compiling a program per length — the vLLM
+        bucketed-prefill idea with static shapes). At the S rung the
+        prefill window only fits with cursor == prompt_len, i.e. at an
+        epoch start — the admission check blocks such a request until the
+        roll provides one."""
+        tb = self.bucket
+        while tb < prompt_len:
+            tb *= 2
+        return min(tb, self.S)
+
     def submit(self, prompt, max_new: int) -> int:
-        """Queue one request; returns its id. prompt: 1-D int sequence."""
+        """Queue one request; returns its id. prompt: 1-D int sequence up
+        to the cache capacity (padded to the next bucket rung)."""
         prompt = list(int(t) for t in prompt)
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
-        if not 0 < len(prompt) <= self.bucket:
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        # Feasible at an epoch start (cursor == P): the prefill window ends
+        # at cursor-P+tb == tb <= S by the ladder clamp, and the decode
+        # rows end at P+rows (the padded tail past P is overwritten by this
+        # slot's own decode steps, so it does NOT consume decode capacity).
+        if len(prompt) + self._rows_needed(max_new) > self.S:
             raise ValueError(
-                f"prompt length {len(prompt)} not in 1..{self.bucket}")
-        if self.bucket + self._rows_needed(max_new) > self.S:
-            raise ValueError("prompt + max_new exceeds cache capacity")
+                f"prompt ({len(prompt)}) + max_new ({max_new}) exceeds "
+                f"cache capacity {self.S}")
         req_id = self._next_id
         self._next_id += 1
         self._budget[req_id] = max_new
@@ -442,7 +464,7 @@ class ContinuousBatcher:
         finished: list = []
         free = [s for s in range(self.n_slots) if s not in self._slot_req]
         blocked: list = []
-        adm: list = []                               # (req id, slot, cursor, prompt)
+        adm: list = []                               # (req id, slot, cursor, prompt, bucket)
         # len(adm) < n_slots: a max_new==1 admission hands its slot straight
         # back to `free`, so without the cap a burst of short requests could
         # admit more than n_slots entries — growing M past n_slots and
@@ -450,11 +472,12 @@ class ContinuousBatcher:
         while free and self._queue and len(adm) < self.n_slots:
             req_id, prompt = self._queue[0]
             P = len(prompt)
+            tb = self._ladder(P)
             # The prompt writes BACKWARD from the cursor; bump the cursor
             # forward (free — just skips rows) if the window would start
             # below 0. Both bounds mirror _prefill_multi_fn's contract.
             cursor = max(self._cursor, P)
-            if (cursor - P + self.bucket > self.S
+            if (cursor - P + tb > self.S
                     or cursor + self._rows_needed(self._budget[req_id])
                     > self.S):
                 # No room this epoch — try again after the roll.
@@ -463,7 +486,7 @@ class ContinuousBatcher:
             self._queue.pop(0)
             self._cursor = cursor
             slot = free.pop()
-            adm.append((req_id, slot, cursor, prompt))
+            adm.append((req_id, slot, cursor, prompt, tb))
             self._budget[req_id] -= 1                # first token = prefill
             if self._budget[req_id] <= 0:            # max_new == 1
                 finished.append(req_id)
@@ -473,39 +496,56 @@ class ContinuousBatcher:
                 self._slot_req[slot] = req_id
         self._queue = blocked + self._queue
 
-        # Every admission rides ONE padded dispatch (see _prefill_multi_fn:
-        # M is always n_slots, short lists repeat the last entry —
-        # idempotent). Host inputs go in as NUMPY values: the tunnel
+        # Admissions ride ONE padded dispatch per bucket rung (usually one
+        # — see _prefill_multi_fn: M is always n_slots, short lists repeat
+        # the last entry, which is idempotent). Writes to distinct slots
+        # commute, so same-bucket entries group regardless of interleaving;
+        # only when a slot REPEATS within the step (freed by a max_new==1
+        # entry and reused) does cross-group ordering matter, and then we
+        # fall back to contiguity-split runs, which preserve admission
+        # order per slot. Host inputs go in as NUMPY values: the tunnel
         # device_puts them asynchronously, while converting Python
         # lists/ints through jnp costs a ~0.7 s synchronous round trip
         # EACH — measured 185 s of a 188 s serving run.
-        if adm:
+        runs: list = []
+        if len({e[1] for e in adm}) == len(adm):     # all slots distinct
+            by_tb: Dict[int, list] = {}
+            for entry in adm:
+                by_tb.setdefault(entry[4], []).append(entry)
+            runs = list(by_tb.values())
+        else:
+            for entry in adm:
+                if runs and runs[-1][0][4] == entry[4]:
+                    runs[-1].append(entry)
+                else:
+                    runs.append([entry])
+        for run in runs:
+            tb = run[0][4]
             # Pad with the LAST entry, not the first: a max_new==1 request
             # frees its slot mid-step, so an earlier entry's slot can be
             # reused by a later one — duplicating an earlier entry would
             # re-apply its superseded writes after the reuser's. Nothing
-            # ever supersedes the last entry within a step.
-            pad = [adm[-1]] * (self.n_slots - len(adm))
-            rows = adm + pad
+            # ever supersedes the last entry within a run.
+            rows = run + [run[-1]] * (self.n_slots - len(run))
             tokens = np.asarray(
-                [p + [0] * (self.bucket - len(p)) for _, _, _, p in rows],
+                [p + [0] * (tb - len(p)) for _, _, _, p, _ in rows],
                 np.int32)
             self._dispatch_no += 1
             (self._k, self._v, self._bitmap, self._rope_pos, self._last,
              firsts_arr) = self._prefill(
                 self.params, self._k, self._v, self._bitmap, self._rope_pos,
                 self._last,
-                np.asarray([s for _, s, _, _ in rows], np.int32),
-                np.asarray([c for _, _, c, _ in rows], np.int32),
+                np.asarray([s for _, s, _, _, _ in rows], np.int32),
+                np.asarray([c for _, _, c, _, _ in rows], np.int32),
                 tokens,
-                np.asarray([len(p) for _, _, _, p in rows], np.int32),
+                np.asarray([len(p) for _, _, _, p, _ in rows], np.int32),
                 np.int32(self._dispatch_no))
             # Prefill already produced each request's FIRST token from the
             # prompt's last-position logits (greedy argmax when
             # temperature == 0 — matching the static generate path — else
             # a slot-keyed categorical sample).
             self._reads.append(
-                ("firsts", firsts_arr, [rid for rid, _, _, _ in adm]))
+                ("firsts", firsts_arr, [rid for rid, _, _, _, _ in run]))
 
         if not self._slot_req:
             return finished

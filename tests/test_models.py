@@ -387,6 +387,31 @@ class TestContinuousBatching:
         assert all(len(done[r]) == 1 for r in ids)
         assert seen_m == {eng.n_slots}, seen_m    # one compiled shape only
 
+    def test_long_prompts_take_the_next_bucket_rung(self):
+        """Prompts longer than prefill_bucket pad to the next power-of-two
+        rung (one compiled prefill per rung) instead of being rejected;
+        mixed rungs admitted in one step dispatch as ordered runs and every
+        stream still matches static generate."""
+        from k8s_gpu_scheduler_tpu.models import generate
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        key = jax.random.PRNGKey(13)
+        lens = [3, 11, 6, 17]                        # rungs 4, 16, 8, 32
+        prompts = [jax.random.randint(jax.random.fold_in(key, i), (n,), 0,
+                                      self.cfg.vocab)
+                   for i, n in enumerate(lens)]
+        eng = ContinuousBatcher(params, self.cfg, n_slots=4, max_len=64,
+                                chunk=2, prefill_bucket=4)
+        ids = [eng.submit(p, max_new=4) for p in prompts]
+        done = eng.run()
+        for p, rid in zip(prompts, ids):
+            ref = generate(params, p[None, :], self.cfg, max_new=4,
+                           max_len=64)
+            assert done[rid] == [int(t) for t in ref[0]], rid
+        with pytest.raises(ValueError):
+            eng.submit(jax.numpy.zeros(70, jax.numpy.int32), max_new=2)
+
     def test_sharded_batcher_matches_single_device_stream(self):
         """ContinuousBatcher under a dp×fsdp×tp mesh (cache batch sharded
         over (dp, fsdp), kv heads over tp — CACHE_SPEC) must emit the same
