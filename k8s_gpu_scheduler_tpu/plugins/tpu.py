@@ -224,7 +224,7 @@ class TPUPlugin(
             # BLOCKS the scheduling thread through the whole MIG reconfig
             # (gpu_plugins.go:436-452); we skip the node and keep scheduling.
             return Status.unschedulable("slice repartition in progress")
-        chips = pod.spec.tpu_chips()
+        chips = self._requested_chips(state, pod)
         if chips == 0:
             # CPU-only pod (busybox smoke, BASELINE config 1) — any Ready
             # node that matches the selector will do.
@@ -247,6 +247,14 @@ class TPUPlugin(
         state.write(f"tpu.nodeinfo/{info.name}", info)
         return Status.success()
 
+    @staticmethod
+    def _requested_chips(state: CycleState, pod: Pod) -> int:
+        """The pod's chip request, from PreFilter's per-cycle cache when
+        present — Filter/Score run per NODE, and re-summing container
+        resources each time was ~8% of the 1024-node cycle."""
+        chips = state.read("tpu.request")
+        return pod.spec.tpu_chips() if chips is None else chips
+
     def _nominated_chips(self, pod: Pod, info: NodeInfo) -> int:
         """Chips reserved on this node for pods nominated by preemption —
         kube-scheduler's addNominatedPods: when filtering pod P, nominated
@@ -256,7 +264,7 @@ class TPUPlugin(
         from ..sched.queue import pod_priority
 
         nominator = getattr(self.handle, "nominator", None)
-        if nominator is None:
+        if not nominator:                            # None OR no nominations
             return 0
         my_prio = pod_priority(pod)
         my_uid = pod.metadata.uid
@@ -471,7 +479,7 @@ class TPUPlugin(
         if info is None:
             return Decision(node_name=node_name), 0.0
 
-        chips_wanted = pod.spec.tpu_chips()
+        chips_wanted = self._requested_chips(state, pod)
         topo = info.slice_topology()
         if chips_wanted == 0 or topo is None:
             # CPU pod or unlabeled node: score by inverse utilization only.

@@ -240,6 +240,12 @@ class Nominator:
         with self._mu:
             return [p for p, n in self._nominated.values() if n == node_name]
 
+    def __bool__(self) -> bool:
+        """True when ANY nomination exists — lets the Filter hot path skip
+        the per-node pods_on scan in the overwhelmingly common no-recent-
+        preemption case (a bare len read is atomic under the GIL)."""
+        return bool(self._nominated)
+
 
 class Handle:
     """What plugins get to see — kube-scheduler's framework.Handle. Carries
