@@ -369,6 +369,13 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
     parser.add_argument("--serve", action="store_true")
     parser.add_argument("--prompt-len", type=int, default=512)
     parser.add_argument("--max-new", type=int, default=64)
+    # Serving engine options (serving.py): weight-only int8, sampling,
+    # EOS early stop — 0/unset keep greedy full-precision fixed-budget.
+    parser.add_argument("--int8", action="store_true",
+                        help="weight-only int8 serving (ops/quant.py)")
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--top-k", type=int, default=0)
+    parser.add_argument("--eos-id", type=int, default=None)
     args = parser.parse_args()
 
     from ..parallel import distributed_init_from_env
@@ -436,10 +443,17 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
         if jax.process_count() == 1:
             from .serving import ContinuousBatcher
 
+            sparams = params
+            if args.int8:
+                from ..ops.quant import quantize_llama_params
+
+                sparams = quantize_llama_params(params)
             n_slots = 8
             eng = ContinuousBatcher(
-                params, cfg, n_slots=n_slots, max_len=cfg.max_seq,
-                chunk=max_new, prefill_bucket=max(Tp, 16), mesh=mesh)
+                sparams, cfg, n_slots=n_slots, max_len=cfg.max_seq,
+                chunk=max_new, prefill_bucket=max(Tp, 16), mesh=mesh,
+                eos_id=args.eos_id, temperature=args.temperature,
+                top_k=args.top_k)
             rng = _np.random.default_rng(0)
 
             def prompt_arr():
@@ -452,10 +466,13 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
                 n_req = 4 * n_slots
                 for _ in range(n_req):
                     eng.submit(prompt_arr(), max_new=max_new)
-                eng.run()
+                done = eng.run()
                 dt = time.perf_counter() - t0
+                # Count tokens actually emitted — with --eos-id, early-
+                # stopped requests decode fewer than max_new.
+                n_tok = sum(len(v) for v in done.values())
                 print(f"llama serve qps={n_req / dt:.2f} "
-                      f"decode_tok_s={n_req * max_new / dt:.1f} "
+                      f"decode_tok_s={n_tok / dt:.1f} "
                       f"prefill_tok={n_req * Tp} slo={slo}", flush=True)
                 if publish is not None:
                     publish(n_req / dt)
@@ -463,6 +480,14 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
                 # registry GET (live neighbors) + SET — a fast wave must
                 # not turn one pod into a tens-of-Hz registry hammer.
                 time.sleep(max(0.0, 1.0 - dt))
+        if args.int8 or args.temperature > 0 or args.eos_id is not None:
+            # Refuse rather than silently downgrade: the static multi-host
+            # handler is full-precision greedy fixed-budget (per-process
+            # host-driven admission can't keep SPMD workers in lockstep).
+            raise SystemExit(
+                "--int8/--temperature/--top-k/--eos-id need the continuous "
+                "batcher, which is single-process only; this gang has "
+                f"{jax.process_count()} processes")
         from .serving import make_server_step
 
         handler = make_server_step(cfg, mesh, max_new, max_len=cfg.max_seq)
