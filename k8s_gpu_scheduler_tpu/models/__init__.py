@@ -14,7 +14,8 @@ from .bert import BertConfig
 from .resnet import ResNetConfig
 from .serving import (
     ContinuousBatcher, cached_attention, forward_with_cache, generate,
-    init_cache, make_server_step,
+    generate_speculative,
+    init_cache, make_server_step, make_speculative_server_step,
 )
 from .pipeline import make_pp_train_step, pp_loss_fn
 
@@ -30,8 +31,10 @@ __all__ = [
     "cached_attention",
     "forward_with_cache",
     "generate",
+    "generate_speculative",
     "init_cache",
     "make_server_step",
+    "make_speculative_server_step",
     "ContinuousBatcher",
     "make_pp_train_step",
     "pp_loss_fn",

@@ -155,6 +155,107 @@ def make_server_step(cfg: LlamaConfig, mesh: Optional[Mesh], max_new: int,
     return jax.jit(fn)
 
 
+def generate_speculative(
+    params: Dict, prompt: jax.Array, cfg: LlamaConfig, max_new: int,
+    gamma: int = 4, max_len: Optional[int] = None,
+) -> jax.Array:
+    """Greedy decode with PROMPT-LOOKUP speculation (n-gram speculative
+    decoding, draft-model-free): each iteration proposes ``gamma`` tokens
+    by bigram match against the sequence so far, verifies them in ONE
+    (1+gamma)-token forward, and accepts the longest prefix agreeing with
+    greedy argmax — plus the model's own next token at the first
+    disagreement. Output matches ``generate`` (acceptance is exact-match
+    against the verify pass's own argmax; the only divergence source is a
+    float near-tie between the differently-shaped passes); text with
+    self-repetition (code, long documents) decodes up to gamma+1 tokens
+    per model pass, and pathological inputs degrade to one token per
+    pass, never below.
+
+    Single request only (B=1): acceptance length varies per row, which a
+    batch cannot share. The cache rewind is safe because stale rows past
+    the rewound ``len`` sit inside the NEXT verify's write window
+    (width 1+gamma at the new position), and forward_with_cache writes
+    each row before any query can attend it.
+    """
+    B, t_prompt = prompt.shape
+    if B != 1:
+        raise ValueError(f"speculative decode is single-request (B=1), got {B}")
+    S = min(max_len or cfg.max_seq, cfg.max_seq)
+    if t_prompt + max_new + gamma > S:
+        # Overshoot room: a verify may write gamma rows past the last
+        # accepted position before the rewind.
+        raise ValueError(
+            f"prompt ({t_prompt}) + max_new ({max_new}) + gamma ({gamma}) "
+            f"exceeds cache/rope capacity ({S})")
+
+    S_buf = t_prompt + max_new + gamma + 1
+    seq = jnp.zeros((1, S_buf), jnp.int32)
+    seq = jax.lax.dynamic_update_slice(seq, prompt.astype(jnp.int32), (0, 0))
+
+    cache = init_cache(cfg, 1, max_len)
+    logits, cache = forward_with_cache(params, prompt, cfg, cache)
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    seq = jax.lax.dynamic_update_slice(seq, first[:, None], (0, t_prompt))
+    # Invariant: seq[:, :n] are decided tokens; cache holds K/V for
+    # seq[:, :n-1] (the newest token is fed to the next forward).
+    n0 = jnp.int32(t_prompt + 1)
+
+    idx = jnp.arange(S_buf)
+
+    def propose(seq, n):
+        """Latest j <= n-2 with seq[j-1:j+1] == seq[n-2:n] → guess
+        seq[j+1 : j+1+gamma]; garbage guesses when no match (they are
+        simply rejected by the verify)."""
+        last2 = jax.lax.dynamic_slice(seq, (0, n - 2), (1, 2))[0]
+        prev = jnp.roll(seq[0], 1)
+        hit = (prev == last2[0]) & (seq[0] == last2[1])
+        valid = (idx >= 1) & (idx <= n - 2)
+        j = jnp.max(jnp.where(hit & valid, idx, -1))
+        return jax.lax.dynamic_slice(seq, (0, jnp.maximum(j, 0) + 1),
+                                     (1, gamma))
+
+    def body(carry):
+        seq, n, cache = carry
+        prop = propose(seq, n)
+        last = jax.lax.dynamic_slice(seq, (0, n - 1), (1, 1))
+        x = jnp.concatenate([last, prop], axis=1)    # [1, 1+gamma]
+        logits, cache = forward_with_cache(params, x, cfg, cache)
+        greedy = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # [1+gamma]
+        accept = jnp.cumprod(
+            (prop[0] == greedy[:-1]).astype(jnp.int32)).sum()
+        # Emit the accepted guesses plus the model's own continuation at
+        # the first miss: exactly greedy[0..accept] — a fixed-width write
+        # of the whole greedy vector, advancing n by only accept+1, keeps
+        # shapes static (rows past n+accept are scratch, overwritten
+        # before ever being read).
+        seq = jax.lax.dynamic_update_slice(seq, greedy[None, :], (0, n))
+        # Rewind: keep K/V only for the accepted prefix. Stale rows in
+        # (n+accept-1, n+gamma-1] fall inside the next verify's write
+        # window starting at the rewound len.
+        cache = {**cache, "len": n - 1 + 1 + accept}
+        return seq, n + accept + 1, cache
+
+    def cond(carry):
+        _, n, _ = carry
+        return n - t_prompt < max_new
+
+    seq, n, _ = jax.lax.while_loop(cond, body, (seq, n0, cache))
+    out = jax.lax.dynamic_slice(seq, (0, t_prompt), (1, max_new))
+    return out.astype(prompt.dtype)                  # match generate's contract
+
+
+def make_speculative_server_step(cfg: LlamaConfig, max_new: int,
+                                 gamma: int = 4,
+                                 max_len: Optional[int] = None):
+    """Jitted handler: (params, prompt [1, Tp]) → [1, max_new] — the
+    make_server_step analog for the speculative path (one compiled program
+    per prompt length; eager calls would pay per-op dispatch under the
+    ~100 ms tunnel round trip)."""
+    fn = partial(generate_speculative, cfg=cfg, max_new=max_new,
+                 gamma=gamma, max_len=max_len)
+    return jax.jit(fn)
+
+
 # -- continuous batching ------------------------------------------------------
 #
 # The static-batch path above decodes one request batch to completion: a

@@ -194,6 +194,56 @@ class TestServing:
         assert jnp.array_equal(out, ref)
 
 
+class TestSpeculativeDecode:
+    """Prompt-lookup speculative decoding (serving.generate_speculative):
+    greedy-exact output, variable per-pass acceptance, degenerate-input
+    safety."""
+
+    cfg = TestServing.f32_cfg()
+
+    def _params(self):
+        return init_params(self.cfg, jax.random.PRNGKey(0))
+
+    def test_matches_generate_on_repetitive_prompt(self):
+        """A self-repeating prompt is the win case — bigram lookups hit,
+        multi-token passes accept — and the output must still equal plain
+        greedy decoding."""
+        from k8s_gpu_scheduler_tpu.models import generate, generate_speculative
+
+        params = self._params()
+        phrase = jax.random.randint(jax.random.PRNGKey(1), (6,), 0,
+                                    self.cfg.vocab)
+        prompt = jnp.tile(phrase, 3)[None, :]        # 18 tokens, repeating
+        ref = generate(params, prompt, self.cfg, max_new=8, max_len=40)
+        got = generate_speculative(params, prompt, self.cfg, max_new=8,
+                                   gamma=4, max_len=40)
+        assert jnp.array_equal(got, ref), (got, ref)
+
+    def test_matches_generate_on_random_prompt(self):
+        """No bigram repeats → every proposal is garbage → one token per
+        pass; output must still be exact."""
+        from k8s_gpu_scheduler_tpu.models import generate, generate_speculative
+
+        params = self._params()
+        prompt = jnp.arange(10)[None, :] * 7 % self.cfg.vocab
+        ref = generate(params, prompt, self.cfg, max_new=6, max_len=40)
+        got = generate_speculative(params, prompt, self.cfg, max_new=6,
+                                   gamma=3, max_len=40)
+        assert jnp.array_equal(got, ref), (got, ref)
+
+    def test_rejects_batch_and_capacity_overflow(self):
+        from k8s_gpu_scheduler_tpu.models import generate_speculative
+
+        params = self._params()
+        two = jnp.zeros((2, 4), jnp.int32)
+        with pytest.raises(ValueError):
+            generate_speculative(params, two, self.cfg, max_new=4)
+        one = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError):
+            generate_speculative(params, one, self.cfg, max_new=60,
+                                 gamma=4, max_len=64)
+
+
 class TestQuantizedServing:
     """Weight-only int8 (ops/quant.py): per-channel round-trip error
     bound, exact equivalence of the qdot path with dequantized weights
