@@ -376,6 +376,11 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--top-k", type=int, default=0)
     parser.add_argument("--eos-id", type=int, default=None)
+    # Elastic recovery (utils/checkpoint.py): gang pods evicted by the
+    # scheduler's all-or-nothing collapse or preemption resume from the
+    # latest step when the controller recreates them.
+    parser.add_argument("--ckpt-dir", default=os.environ.get("TPU_CKPT_DIR"))
+    parser.add_argument("--ckpt-every", type=int, default=100)
     args = parser.parse_args()
 
     from ..parallel import distributed_init_from_env
@@ -516,14 +521,36 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
     # (eager zeros_like would be fine single-host; multi-host needs it).
     state = jax.jit(opt.init)(params)
     step = make_train_step(cfg, mesh, opt)
-    while True:
-        t0 = time.perf_counter()
-        params, state, loss = step(params, state, batch)
-        tok_s = B * T / (time.perf_counter() - t0)
-        print(f"llama pretrain worker={worker_id} "
-              f"tok/s={tok_s:.0f} loss={float(loss):.3f}", flush=True)
-        if publish is not None and worker_id == 0:
-            publish(tok_s)
+
+    ckpt = None
+    step_no = 0
+    if args.ckpt_dir:
+        from ..utils.checkpoint import TrainCheckpointer
+
+        ckpt = TrainCheckpointer(args.ckpt_dir)
+        # The fresh (params, state) is the restore template: it carries
+        # the pytree structure AND the mesh shardings, so a multi-host
+        # restore lands shards where the train step expects them.
+        step_no, (params, state) = ckpt.restore_or(lambda: (params, state))
+        if step_no:
+            print(f"llama pretrain worker={worker_id} resumed at step "
+                  f"{step_no} from {args.ckpt_dir}", flush=True)
+    try:
+        while True:
+            t0 = time.perf_counter()
+            params, state, loss = step(params, state, batch)
+            step_no += 1
+            tok_s = B * T / (time.perf_counter() - t0)
+            print(f"llama pretrain worker={worker_id} step={step_no} "
+                  f"tok/s={tok_s:.0f} loss={float(loss):.3f}", flush=True)
+            if ckpt is not None:
+                ckpt.maybe_save(step_no, (params, state),
+                                every=args.ckpt_every)
+            if publish is not None and worker_id == 0:
+                publish(tok_s)
+    finally:
+        if ckpt is not None:
+            ckpt.close()                             # drain async saves + release
 
 
 if __name__ == "__main__":  # pragma: no cover
