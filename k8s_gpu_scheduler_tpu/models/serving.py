@@ -15,6 +15,12 @@ second — VERDICT.md weak #3) with a real inference path:
   ``generate`` constrains it when a mesh is passed, so multi-chip serving
   shards the cache instead of replicating it.
 
+On top of the static path: ``ContinuousBatcher`` (slot admission between
+decode chunks, batched one-dispatch prefill with a bucket ladder for long
+prompts, deferred readbacks, EOS early-stop, temperature/top-k sampling,
+int8 weights via ops/quant.py) and ``generate_speculative`` (prompt-lookup
+speculation, draft-model-free).
+
 The reference has no serving engine at all (it schedules inference pods,
 SURVEY.md §0); this is the workload side of BASELINE config 5
 (serving + training co-located), which the TPU plugin right-sizes against
