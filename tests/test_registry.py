@@ -189,6 +189,35 @@ class TestKVStore:
         finally:
             srv2.stop()
 
+    def test_aof_auto_rewrite_compacts_superseded_writes(self, tmp_path):
+        """Heartbeat-style rewrites of the same key grow the log past the
+        1 MiB floor and double threshold (kvstore.cpp aof_record); the
+        auto-rewrite must compact it to live state only, and a restart
+        must replay the compacted log to the LAST value."""
+        aof = str(tmp_path / "compact.aof")
+        srv = KVServer(appendonly=aof)
+        try:
+            with Client(port=srv.port) as c:
+                val = "x" * 10_000
+                for i in range(130):                 # ~1.3 MB of records
+                    c.set("node/hb", f"{val}{i}")
+                c.set("keep", "final")
+            size = os.path.getsize(aof)
+            # The rewrite fires crossing the 1 MiB floor and compacts the
+            # log to the ~10 KB live value; appends written AFTER it
+            # remain (~250 KB here) until the next doubling. Without any
+            # rewrite the log would be the full ~1.3 MB.
+            assert size < 500_000, size
+        finally:
+            srv.stop()
+        srv2 = KVServer(appendonly=aof)
+        try:
+            with Client(port=srv2.port) as c:
+                assert c.get("node/hb") == f"{val}129"
+                assert c.get("keep") == "final"
+        finally:
+            srv2.stop()
+
     def test_client_reconnects_after_server_restart(self, tmp_path):
         aof = str(tmp_path / "r.aof")
         srv = KVServer(appendonly=aof)
