@@ -3,9 +3,16 @@ column→value dicts that satisfy plugins.tpu.PredictionClient directly.
 
 Unlike the reference's dial-per-call clients, one channel persists for the
 client's lifetime (the scoring hot loop makes 2 calls per resident pod —
-re-dialing each would dominate the cycle)."""
+re-dialing each would dominate the cycle), and replies are memoized for a
+short TTL: predictions only move on the server's retrain cadence (30 s
+md5 watch, server.py), so scoring many nodes against the same resident
+pods within a cycle — or across back-to-back cycles — repeats identical
+queries. The reference pays the full quadratic RPC cost every cycle
+(gpu_plugins.go:577-590)."""
 from __future__ import annotations
 
+import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from .wire import (
@@ -18,10 +25,15 @@ from .wire import (
 
 class Client:
     def __init__(self, host: str = "127.0.0.1", port: int = 32700,
-                 timeout_s: float = 2.0):
+                 timeout_s: float = 2.0, cache_ttl_s: float = 5.0):
         import grpc
 
         self._timeout = timeout_s
+        self._ttl = cache_ttl_s
+        # (method, index) -> (expiry, reply dict). Errors are never cached
+        # (a transient server outage must not pin failures for a TTL).
+        self._cache: Dict[Tuple[str, str], Tuple[float, Dict[str, float]]] = {}
+        self._mu = threading.Lock()
         self._channel = grpc.insecure_channel(f"{host}:{port}")
         self._conf = self._channel.unary_unary(
             METHOD_CONFIGURATIONS,
@@ -34,13 +46,31 @@ class Client:
             response_deserializer=decode_reply,
         )
 
+    def _cached(self, kind: str, index: str, call) -> Dict[str, float]:
+        now = time.monotonic()
+        key = (kind, index)
+        if self._ttl > 0:
+            with self._mu:
+                hit = self._cache.get(key)
+                if hit is not None and hit[0] > now:
+                    # Copy: callers own their reply dict — handing out the
+                    # cached object would let one caller's mutation poison
+                    # every later hit.
+                    return dict(hit[1])
+        result, columns = call(index, timeout=self._timeout)
+        reply = dict(zip(columns, result))
+        if self._ttl > 0:
+            with self._mu:
+                if len(self._cache) > 4096:          # scoring-universe bound
+                    self._cache.clear()
+                self._cache[key] = (now + self._ttl, reply)
+        return reply
+
     def impute_configurations(self, index: str) -> Dict[str, float]:
-        result, columns = self._conf(index, timeout=self._timeout)
-        return dict(zip(columns, result))
+        return self._cached("conf", index, self._conf)
 
     def impute_interference(self, index: str) -> Dict[str, float]:
-        result, columns = self._intf(index, timeout=self._timeout)
-        return dict(zip(columns, result))
+        return self._cached("intf", index, self._intf)
 
     def close(self) -> None:
         self._channel.close()

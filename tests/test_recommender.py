@@ -135,6 +135,68 @@ class TestService:
         assert find_max_index(preds) == ("1P_V5P", 150.0)
         assert find_max_index(preds, "V5E") == ("1P_V5E", 100.0)
 
+    def test_client_ttl_cache_short_circuits_repeats(self, server):
+        """Within the TTL a repeated (method, index) query never leaves the
+        client — scoring N nodes against the same resident pods repeats
+        identical queries, and the server only changes on its 30 s retrain
+        cadence. Distinct methods/indices stay distinct, a served ERROR is
+        not cached, and ttl=0 disables the memo."""
+        with Client(port=server.port, cache_ttl_s=60.0) as c:
+            calls = {"n": 0}
+            orig = c._conf
+
+            def counting(index, timeout=None):
+                calls["n"] += 1
+                return orig(index, timeout=timeout)
+
+            c._conf = counting
+            a = c.impute_configurations("bert-base-infer")
+            b = c.impute_configurations("bert-base-infer")
+            assert a == b and calls["n"] == 1        # second hit cached
+            c.impute_configurations("resnet50-train")
+            assert calls["n"] == 2                   # distinct index: miss
+            # SAME index through the other METHOD must be a separate cache
+            # key (a regression keying on index alone would serve
+            # configuration rows to interference queries).
+            intf = c.impute_interference("bert-base-infer")
+            assert calls["n"] == 2                   # own channel, not _conf
+            assert intf != a
+            # Mutating a returned reply must not poison later cache hits.
+            a_again = c.impute_configurations("bert-base-infer")
+            a_again["1P_V5E"] = -1.0
+            assert c.impute_configurations("bert-base-infer")["1P_V5E"] != -1.0
+        with Client(port=server.port, cache_ttl_s=0.0) as c:
+            calls = {"n": 0}
+            orig = c._conf
+
+            def counting0(index, timeout=None):
+                calls["n"] += 1
+                return orig(index, timeout=timeout)
+
+            c._conf = counting0
+            c.impute_configurations("bert-base-infer")
+            c.impute_configurations("bert-base-infer")
+            assert calls["n"] == 2                   # ttl=0: no memo
+
+    def test_client_does_not_cache_errors(self, server):
+        """A transient failure must not pin an error (or stale emptiness)
+        for the TTL — only successful replies are memoized."""
+        with Client(port=server.port, cache_ttl_s=60.0) as c:
+            fail = {"on": True}
+            orig = c._conf
+
+            def flaky(index, timeout=None):
+                if fail["on"]:
+                    raise RuntimeError("transient")
+                return orig(index, timeout=timeout)
+
+            c._conf = flaky
+            with pytest.raises(RuntimeError):
+                c.impute_configurations("bert-base-infer")
+            fail["on"] = False
+            preds = c.impute_configurations("bert-base-infer")
+            assert preds["1P_V5E"] == pytest.approx(3900.0)
+
     def test_plugin_consumes_real_service(self, server):
         """The gRPC client satisfies plugins.tpu.PredictionClient: the
         SLO-slack scorer runs against the live server."""
@@ -381,7 +443,9 @@ class TestCollector:
         server = RecommenderServer(conf, intf, port=0,
                                    retrain_interval_s=0.1).start()
         try:
-            client = Client("127.0.0.1", server.port)
+            # ttl=0: this test polls for retrain freshness — the client's
+            # reply memo would otherwise hide the new matrix for its TTL.
+            client = Client("127.0.0.1", server.port, cache_ttl_s=0.0)
             before = client.impute_configurations("llama3-8b-serve-0")
             assert before, "seed lookup must hit"
             reg = FakeRegistryKV()
