@@ -29,7 +29,7 @@ class ConnectionLost(RegistryError):
 # Commands safe to transparently re-send after a reconnect. DEL is absent on
 # purpose: re-sending it after a dropped reply would erase the key a second
 # time and report 0, lying to the caller about whether the key existed.
-_IDEMPOTENT = {"GET", "SET", "GETRANGE", "KEYS", "EXISTS", "DBSIZE", "PING", "INFO", "FLUSHDB"}
+_IDEMPOTENT = {"GET", "MGET", "SET", "GETRANGE", "KEYS", "EXISTS", "DBSIZE", "PING", "INFO", "FLUSHDB"}
 
 
 class Client:
@@ -170,6 +170,13 @@ class Client:
 
     def get(self, key: str) -> Optional[str]:
         return self._call("GET", key)
+
+    def mget(self, *keys: str) -> List[Optional[str]]:
+        """Values for ``keys`` in order, None per missing key — one round
+        trip for a whole fleet's inventories (Redis MGET semantics)."""
+        if not keys:
+            return []
+        return self._call("MGET", *keys)
 
     def get_range(self, key: str, start: int, end: int) -> str:
         return self._call("GETRANGE", key, str(start), str(end)) or ""

@@ -126,11 +126,25 @@ def read_inventory(client, node_name: str) -> Optional[NodeInventory]:
 
 
 def list_inventories(client) -> Dict[str, NodeInventory]:
+    keys = [k for k in client.get_keys(NODE_KEY_PREFIX + "*")
+            if not k.endswith(HEARTBEAT_SUFFIX)]
+    if not keys:
+        return {}
+    # One MGET round trip per 512 keys (N+1 GETs before — at 256 nodes
+    # that was 257 network round trips per listing). Chunked: kvstored's
+    # RESP reader caps a command at 1024 array elements, so an unchunked
+    # fleet-wide MGET would hard-drop the connection at >=1023 nodes.
+    # Registries without mget (test fakes, plain KV stores) keep the
+    # per-key path.
+    mget = getattr(client, "mget", None)
+    if callable(mget):
+        values: List[Optional[str]] = []
+        for i in range(0, len(keys), 512):
+            values.extend(mget(*keys[i:i + 512]))
+    else:
+        values = [client.get(k) for k in keys]
     out: Dict[str, NodeInventory] = {}
-    for key in client.get_keys(NODE_KEY_PREFIX + "*"):
-        if key.endswith(HEARTBEAT_SUFFIX):
-            continue
-        raw = client.get(key)
+    for raw in values:
         if raw is None:
             continue
         try:

@@ -298,6 +298,17 @@ std::string execute(Session& sess, const std::vector<std::string>& argv, bool re
     auto it = db.find(argv[1]);
     return it == db.end() ? null_bulk() : bulk(it->second);
   }
+  if (cmd == "MGET") {
+    // Redis MGET: one array reply, nil per missing key — lets
+    // list_inventories fetch a fleet in 2 round trips instead of N+1.
+    if (argv.size() < 2) return err("wrong number of arguments for 'mget'");
+    std::string out = array_hdr(argv.size() - 1);
+    for (size_t i = 1; i < argv.size(); i++) {
+      auto it = db.find(argv[i]);
+      out += it == db.end() ? null_bulk() : bulk(it->second);
+    }
+    return out;
+  }
   if (cmd == "GETRANGE") {
     // Parity with client.Descriptor.GetRange (client.go:36-40).
     if (argv.size() != 4) return err("wrong number of arguments for 'getrange'");

@@ -103,6 +103,13 @@ class TestKVStore:
             assert sorted(c.get_keys("*")) == ["node/a", "node/b", "other"]
             assert sorted(c.get_keys("node/?")) == ["node/a", "node/b"]
 
+    def test_mget_order_and_missing_nils(self, server):
+        with Client(port=server.port) as c:
+            c.set("a", "1")
+            c.set("b", "2")
+            assert c.mget("b", "missing", "a") == ["2", None, "1"]
+            assert c.mget() == []
+
     def test_delete_exists_dbsize_flush(self, server):
         with Client(port=server.port) as c:
             c.set("a", "1")
@@ -263,6 +270,29 @@ class TestInventorySchema:
             c.set("node/good/heartbeat", "123")
             invs = list_inventories(c)
             assert list(invs) == ["good"]
+
+    def test_list_inventories_uses_one_mget(self, server):
+        """A fleet listing must cost 2 round trips (KEYS + MGET), not
+        N+1 — and still work against registries without mget."""
+        with Client(port=server.port) as c:
+            for i in range(5):
+                publish_inventory(c, NodeInventory(node_name=f"n{i}",
+                                                   topology="2x4"))
+            gets = {"n": 0}
+            orig_get = c.get
+            def counting(key):
+                gets["n"] += 1
+                return orig_get(key)
+            c.get = counting
+            invs = list_inventories(c)
+            assert sorted(invs) == [f"n{i}" for i in range(5)]
+            assert gets["n"] == 0                    # MGET path, no GETs
+
+            class NoMget:                            # plain-KV fallback
+                get_keys = c.get_keys
+                get = staticmethod(orig_get)
+            invs2 = list_inventories(NoMget())
+            assert sorted(invs2) == sorted(invs)
 
 
 class TestCtl:
