@@ -485,7 +485,8 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
                 # registry GET (live neighbors) + SET — a fast wave must
                 # not turn one pod into a tens-of-Hz registry hammer.
                 time.sleep(max(0.0, 1.0 - dt))
-        if args.int8 or args.temperature > 0 or args.eos_id is not None:
+        if (args.int8 or args.temperature > 0 or args.top_k > 0
+                or args.eos_id is not None):
             # Refuse rather than silently downgrade: the static multi-host
             # handler is full-precision greedy fixed-budget (per-process
             # host-driven admission can't keep SPMD workers in lockstep).

@@ -570,7 +570,6 @@ class ContinuousBatcher:
 
         finished: list = []
         free = [s for s in range(self.n_slots) if s not in self._slot_req]
-        blocked: list = []
         adm: list = []                               # (req id, slot, cursor, prompt, bucket)
         # len(adm) < n_slots: a max_new==1 admission hands its slot straight
         # back to `free`, so without the cap a burst of short requests could
@@ -587,9 +586,14 @@ class ContinuousBatcher:
             if (cursor - P + tb > self.S
                     or cursor + self._rows_needed(self._budget[req_id])
                     > self.S):
-                # No room this epoch — try again after the roll.
-                blocked.append(self._queue.pop(0))
-                continue
+                # No room this epoch — STOP admitting (strict FCFS). Letting
+                # later, smaller requests past the blocked head would keep
+                # consuming cursor rows: under sustained short-request load
+                # the slots would never all drain, the epoch never rolls,
+                # and a long-prompt head starves indefinitely (r4 advisor).
+                # With admission frozen the occupied slots finish, the epoch
+                # rolls, and the head admits at cursor == P.
+                break
             self._queue.pop(0)
             self._cursor = cursor
             slot = free.pop()
@@ -601,7 +605,6 @@ class ContinuousBatcher:
                 free.append(slot)                    # slot never occupied
             else:
                 self._slot_req[slot] = req_id
-        self._queue = blocked + self._queue
 
         # Admissions ride ONE padded dispatch per bucket rung (usually one
         # — see _prefill_multi_fn: M is always n_slots, short lists repeat

@@ -30,7 +30,7 @@ from __future__ import annotations
 import logging
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Protocol, Tuple
+from typing import Dict, List, Optional, Protocol, Sequence, Tuple
 
 from ..api.objects import ANN_RESHAPE_STATE, Pod
 from ..api.topology import SliceTopology, TPUGen, chip_count, parse_topology
@@ -87,11 +87,14 @@ class InventorySource(Protocol):
 
 @dataclass
 class Partition:
-    """One assignable sub-slice of a host board (the MIG-instance analogue)."""
+    """One assignable sub-slice of a host board (the MIG-instance analogue).
+    chip_ids is a tuple: Partition objects are shared read-only from the
+    carve cache across cycles, so an in-place edit would poison every later
+    Score call."""
 
-    key: str              # e.g. "part-0/2x2"
-    topology: str         # sub-slice shape, e.g. "2x2"
-    chip_ids: List[int]   # device ids owned by this partition
+    key: str                    # e.g. "part-0/2x2"
+    topology: str               # sub-slice shape, e.g. "2x2"
+    chip_ids: Tuple[int, ...]   # device ids owned by this partition
 
 
 @dataclass
@@ -193,8 +196,8 @@ class TPUPlugin(
             self._cm_lister = None
         # node -> (raw registry value, parsed inventory); see _inventory.
         self._inv_parse_cache: Dict[str, Tuple[str, Optional[NodeInventory]]] = {}
-        # (dims, gen, config-annotation) -> carved Partition list (read-only).
-        self._carve_cache: Dict[Tuple, List[Partition]] = {}
+        # (dims, gen, config-annotation) -> carved Partition tuple (read-only).
+        self._carve_cache: Dict[Tuple, Tuple[Partition, ...]] = {}
         # pod uid -> (node, partition key) recorded at Reserve; bridges the
         # Reserve -> ConfigMap-visible-in-lister window (see reserve()).
         self._assigned_memo: Dict[str, Tuple[str, str]] = {}
@@ -264,7 +267,7 @@ class TPUPlugin(
         from ..sched.queue import pod_priority
 
         nominator = getattr(self.handle, "nominator", None)
-        if not nominator:                            # None OR no nominations
+        if nominator is None or not nominator.has_nominations():
             return 0
         my_prio = pod_priority(pod)
         my_uid = pod.metadata.uid
@@ -516,7 +519,7 @@ class TPUPlugin(
         self,
         info: NodeInfo,
         topo: SliceTopology,
-        partitions: List[Partition],
+        partitions: Sequence[Partition],
         pod: Pod,
         slo: float,
         chips_wanted: int,
@@ -651,7 +654,7 @@ class TPUPlugin(
 
     def _partitions(
         self, info: NodeInfo, topo: SliceTopology, inv: Optional[NodeInventory]
-    ) -> List[Partition]:
+    ) -> Tuple[Partition, ...]:
         """Carve the host board into assignable partitions according to the
         node's current slice config annotation (the nvidia.com/mig.config
         analogue) — default one whole-board partition. Board size comes from
@@ -683,21 +686,21 @@ class TPUPlugin(
             per = total
         per = max(1, min(per, total))
         count = total // per
-        parts = [
+        parts = tuple(
             Partition(
                 key=f"part-{i}/{shown}",
                 topology=shown,
-                chip_ids=list(range(i * per, (i + 1) * per)),
+                chip_ids=tuple(range(i * per, (i + 1) * per)),
             )
             for i in range(count)
-        ]
+        )
         if len(self._carve_cache) > 1024:
             self._carve_cache.clear()
         self._carve_cache[memo_key] = parts
         return parts
 
     def residents_by_partition(
-        self, info: NodeInfo, partitions: List[Partition]
+        self, info: NodeInfo, partitions: Sequence[Partition]
     ) -> Dict[str, List[Pod]]:
         """partition key → chip-consuming residents, attributed by ConfigMap
         readback ({nodeName: partition} written at PostBind); pods with no
@@ -729,7 +732,7 @@ class TPUPlugin(
         return out
 
     def _placed_slos(
-        self, info: NodeInfo, partitions: List[Partition]
+        self, info: NodeInfo, partitions: Sequence[Partition]
     ) -> Dict[str, Dict[str, float]]:
         """partition key → {pod name → SLO} for pods already on the node —
         GetSLOs parity (gpu_plugins.go:87-160)."""
@@ -771,7 +774,7 @@ class TPUPlugin(
     def _pick_free_partition(
         self,
         info: NodeInfo,
-        partitions: List[Partition],
+        partitions: Sequence[Partition],
         chips_wanted: int,
         inv: Optional[NodeInventory] = None,
     ) -> Optional[Partition]:
@@ -816,7 +819,7 @@ class TPUPlugin(
         self,
         decision: Decision,
         topo: SliceTopology,
-        partitions: List[Partition],
+        partitions: Sequence[Partition],
         inv: Optional[NodeInventory] = None,
     ) -> None:
         """HBM/duty caps when the host is shared — the MPS-limit analogue

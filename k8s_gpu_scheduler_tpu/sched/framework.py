@@ -240,10 +240,12 @@ class Nominator:
         with self._mu:
             return [p for p, n in self._nominated.values() if n == node_name]
 
-    def __bool__(self) -> bool:
+    def has_nominations(self) -> bool:
         """True when ANY nomination exists — lets the Filter hot path skip
         the per-node pods_on scan in the overwhelmingly common no-recent-
-        preemption case (a bare len read is atomic under the GIL)."""
+        preemption case (a bare len read is atomic under the GIL). An
+        explicit method, not __bool__: truthiness on a Nominator must keep
+        meaning 'exists' for `if handle.nominator:` callers."""
         return bool(self._nominated)
 
 

@@ -490,6 +490,44 @@ class TestContinuousBatching:
         assert all(len(done[r]) == 1 for r in ids)
         assert seen_m == {eng.n_slots}, seen_m    # one compiled shape only
 
+    def test_blocked_long_head_is_not_starved_by_short_requests(self):
+        """Strict FCFS at a blocked head (serving.py _step_lazy): a
+        long-prompt request that can't fit mid-epoch must NOT be bypassed
+        by later short requests — skip-ahead admission keeps consuming
+        cursor rows, the epoch never rolls, and the head starves (r4
+        advisor finding). With admission frozen the occupied slots drain,
+        the epoch rolls, and the head decodes exactly like static
+        generate."""
+        from k8s_gpu_scheduler_tpu.models import generate
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        key = jax.random.PRNGKey(13)
+        eng = ContinuousBatcher(params, self.cfg, n_slots=2, max_len=32,
+                                chunk=2, prefill_bucket=4)
+        # Two residents push the cursor deep into the epoch...
+        filler = [jax.random.randint(jax.random.fold_in(key, i), (4,), 0,
+                                     self.cfg.vocab) for i in range(2)]
+        filler_ids = [eng.submit(p, max_new=16) for p in filler]
+        # ...so this head (prompt 12 + rows for 10 tokens) blocks, while a
+        # stream of tiny requests queues behind it.
+        long_prompt = jax.random.randint(jax.random.fold_in(key, 9), (12,), 0,
+                                         self.cfg.vocab)
+        long_id = eng.submit(long_prompt, max_new=10)
+        short_ids = [eng.submit(
+            jax.random.randint(jax.random.fold_in(key, 20 + i), (4,), 0,
+                               self.cfg.vocab), max_new=2) for i in range(6)]
+        done = {}
+        for _ in range(80):
+            done.update(eng.step())
+            if not eng.pending:
+                break
+        assert not eng.pending, "head starved: queue never drained"
+        assert set(done) == set(filler_ids) | {long_id} | set(short_ids)
+        ref = generate(params, long_prompt[None, :], self.cfg, max_new=10,
+                       max_len=32)
+        assert done[long_id] == [int(t) for t in ref[0]]
+
     def test_long_prompts_take_the_next_bucket_rung(self):
         """Prompts longer than prefill_bucket pad to the next power-of-two
         rung (one compiled prefill per rung) instead of being rejected;

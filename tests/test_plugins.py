@@ -378,7 +378,7 @@ class TestTPUScore:
         assert plugin.filter(state, pod, sched.cache.snapshot()["w0"]).ok
         plugin.score(state, pod, "w0")
         decision = state.read("tpu.decision/w0")
-        assert decision.partition.chip_ids == [0, 1, 2, 3]
+        assert decision.partition.chip_ids == (0, 1, 2, 3)
         assert decision.partition.topology == "2x2"
 
     def test_partition_carving_from_annotation(self):
@@ -398,7 +398,7 @@ class TestTPUScore:
         decision = state.read("tpu.decision/n1")
         assert decision.partition is not None
         assert decision.partition.topology == "2x2"
-        assert decision.partition.chip_ids in ([0, 1, 2, 3], [4, 5, 6, 7])
+        assert decision.partition.chip_ids in ((0, 1, 2, 3), (4, 5, 6, 7))
         # Shared host → HBM/duty caps (MPS-limit analogue).
         assert decision.hbm_limit_bytes > 0
         assert decision.duty_pct == 50
@@ -444,7 +444,7 @@ class TestPerChipPartitionChoice:
         self._publish_chips(reg, "n1", duties=[0.8, 0.8, 0.8, 0.8,
                                                0.1, 0.1, 0.1, 0.1])
         decision = self._scored_decision(reg, mk_pod("p", chips=4))
-        assert decision.partition.chip_ids == [4, 5, 6, 7]
+        assert decision.partition.chip_ids == (4, 5, 6, 7)
 
     def test_hbm_breaks_duty_ties(self):
         """Equal duty, partition 0 holds more HBM → partition 1 wins."""
@@ -456,7 +456,7 @@ class TestPerChipPartitionChoice:
             hbm_total=[16 * gib] * 8,
         )
         decision = self._scored_decision(reg, mk_pod("p", chips=4))
-        assert decision.partition.chip_ids == [4, 5, 6, 7]
+        assert decision.partition.chip_ids == (4, 5, 6, 7)
 
     def test_sharing_limit_debits_used_hbm(self):
         """The injected HBM cap is what's actually free on the partition,
@@ -471,7 +471,7 @@ class TestPerChipPartitionChoice:
         )
         # Partition 0 is fully free: cap = 4 chips × 16 GiB.
         decision = self._scored_decision(reg, mk_pod("p", chips=4))
-        assert decision.partition.chip_ids == [0, 1, 2, 3]
+        assert decision.partition.chip_ids == (0, 1, 2, 3)
         assert decision.hbm_limit_bytes == 4 * 16 * gib
         # Make partition 0 the busy one; the winner (1) debits its 16 GiB.
         self._publish_chips(
@@ -480,7 +480,7 @@ class TestPerChipPartitionChoice:
             hbm_total=[16 * gib] * 8,
         )
         decision = self._scored_decision(reg, mk_pod("p", chips=4))
-        assert decision.partition.chip_ids == [4, 5, 6, 7]
+        assert decision.partition.chip_ids == (4, 5, 6, 7)
         assert decision.hbm_limit_bytes == 4 * 16 * gib - 4 * 4 * gib
 
     def test_slo_score_tie_breaks_on_duty(self):
@@ -500,7 +500,7 @@ class TestPerChipPartitionChoice:
         assert plugin.filter(state, pod, sched.cache.snapshot()["n1"]).ok
         plugin.score(state, pod, "n1")
         decision = state.read("tpu.decision/n1")
-        assert decision.partition.chip_ids == [4, 5, 6, 7]
+        assert decision.partition.chip_ids == (4, 5, 6, 7)
 
 
 class TestNeighborInjection:
