@@ -138,35 +138,21 @@ def bench_schedule_churn(n_nodes=16, n_pods=64, rest=False, suffix=None):
             fake_proc.wait(timeout=5)
 
 
-def bench_train_mfu():
+def _mfu_one(cfg, B, T, steps):
+    """One train-MFU measurement: compile, warm, N steps, ONE host sync at
+    the end. float() (unlike block_until_ready, which the axon tunnel
+    resolves early) cannot return until the value exists, and the value of
+    step N's loss data-depends on steps 1..N-1 through the donated params —
+    so this bounds all device work. Syncing every step (round-2 bench)
+    charged the ~96 ms tunnel round-trip latency to every step and
+    under-read throughput ~2x."""
     import jax
     import jax.numpy as jnp
     import optax
 
-    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params, make_train_step
+    from k8s_gpu_scheduler_tpu.models import init_params, make_train_step
 
     dev = jax.devices()[0]
-    on_tpu = dev.platform != "cpu"
-    if on_tpu:
-        # Llama-8B's width (d_model 4096, GQA 2:1) at 2 layers — the widest
-        # shape the remote-compile budget allows. Width is what MFU rewards:
-        # the r3 d1024×6 shape read 44.6%, this one ~82% on the same chip
-        # (each [8192,4096]×[4096,16384] matmul runs the MXU near peak;
-        # narrow layers leave it draining between ops).
-        cfg = LlamaConfig(
-            vocab=32000, d_model=4096, n_layers=2, n_heads=32, n_kv_heads=16,
-            d_ff=16384, max_seq=1024, remat=False, attn_impl="flash",
-        )
-        # B=12: measured 81.8% MFU vs 79% at B=8 (B=16 exceeds the
-        # remote-compile memory budget).
-        B, T, steps = 12, 1024, 20
-    else:
-        cfg = LlamaConfig(
-            vocab=1024, d_model=128, n_layers=2, n_heads=8, n_kv_heads=8,
-            d_ff=256, max_seq=256, remat=False,
-        )
-        B, T, steps = 2, 128, 2
-
     params = init_params(cfg, jax.random.PRNGKey(0))
     tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab)
     batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
@@ -179,12 +165,6 @@ def bench_train_mfu():
     t0 = time.perf_counter()
     for _ in range(steps):
         params, state, loss = step(params, state, batch)
-    # ONE host sync at the end. float() (unlike block_until_ready, which the
-    # axon tunnel resolves early) cannot return until the value exists, and
-    # the value of step N's loss data-depends on steps 1..N-1 through the
-    # donated params — so this bounds all device work. Syncing every step
-    # (round-2 bench) charged the ~96 ms tunnel round-trip latency to every
-    # step and under-read throughput ~2×.
     float(loss)
     dt = (time.perf_counter() - t0) / steps
 
@@ -197,18 +177,100 @@ def bench_train_mfu():
             peak = tf * 1e12
             break
     mfu = round(100.0 * achieved / peak, 2) if peak else None
+    return kind or dev.platform, dt, tokens_per_s, mfu
+
+
+def bench_train_mfu():
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if on_tpu:
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig
+
+        # Llama-8B's width (d_model 4096, GQA 2:1) at 2 layers — the widest
+        # shape the remote-compile budget allows. Width is what MFU rewards:
+        # the r3 d1024×6 shape read 44.6%, this one ~82% on the same chip
+        # (each [8192,4096]×[4096,16384] matmul runs the MXU near peak;
+        # narrow layers leave it draining between ops). B=12: measured
+        # 81.8% MFU vs 79% at B=8 (B=16 exceeds the remote-compile budget).
+        wide = LlamaConfig(
+            vocab=32000, d_model=4096, n_layers=2, n_heads=32, n_kv_heads=16,
+            d_ff=16384, max_seq=1024, remat=False, attn_impl="flash",
+        )
+        kind, dt, tok_s, mfu = _mfu_one(wide, B=12, T=1024, steps=20)
+        out = {
+            "device": kind,
+            "step_ms": round(dt * 1000, 1),
+            "tokens_per_s": round(tok_s, 0),
+            "mfu_pct": mfu,
+        }
+        # REALISTIC DEPTH (VERDICT r4 #6): ~1.2B params (d2048 x 16 layers)
+        # with full adamw state — shows the wide-2-layer number is not a
+        # depth artifact. remat on: bf16 params+moments ~7 GB, and the
+        # un-rematerialized backward's per-layer stashes don't fit next to
+        # them at B=8.
+        deep = LlamaConfig(
+            vocab=32000, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=16,
+            d_ff=8192, max_seq=1024, remat=True, attn_impl="flash",
+        )
+        try:
+            _, dt_d, tok_d, mfu_d = _mfu_one(deep, B=8, T=1024, steps=10)
+            out.update({
+                "step_deep_ms": round(dt_d * 1000, 1),
+                "tokens_per_s_deep": round(tok_d, 0),
+                "mfu_deep_pct": mfu_d,
+            })
+        except Exception as e:  # noqa: BLE001 — deep leg must not kill wide
+            out["mfu_deep_error"] = str(e)[:200]
+        return out
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab=1024, d_model=128, n_layers=2, n_heads=8, n_kv_heads=8,
+        d_ff=256, max_seq=256, remat=False,
+    )
+    kind, dt, tok_s, mfu = _mfu_one(cfg, B=2, T=128, steps=2)
     return {
-        "device": kind or dev.platform,
+        "device": kind,
         "step_ms": round(dt * 1000, 1),
-        "tokens_per_s": round(tokens_per_s, 0),
+        "tokens_per_s": round(tok_s, 0),
         "mfu_pct": mfu,
+    }
+
+
+def _pctl(vals, q):
+    """Nearest-rank percentile of a list (no numpy needed at call sites)."""
+    vals = sorted(vals)
+    if not vals:
+        return 0.0
+    idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
+    return vals[idx]
+
+
+def _latency_stats(metrics, prefix=""):
+    """Fold ContinuousBatcher.pop_request_metrics() records into the
+    p50/p99 fields the SLO loop verifies (VERDICT r4 #2: an SLO you never
+    measure cannot be verified)."""
+    ttft = [m["ttft_s"] * 1000 for m in metrics.values()]
+    lat = [m["latency_s"] * 1000 for m in metrics.values()]
+    return {
+        f"{prefix}ttft_p50_ms": round(_pctl(ttft, 0.50), 1),
+        f"{prefix}ttft_p99_ms": round(_pctl(ttft, 0.99), 1),
+        f"{prefix}lat_p50_ms": round(_pctl(lat, 0.50), 1),
+        f"{prefix}lat_p99_ms": round(_pctl(lat, 0.99), 1),
     }
 
 
 def bench_serving():
     """BASELINE config 5's serving side: continuous-batching QPS on the
     real chip (skipped on CPU — the interpreted decode would dominate the
-    line with noise)."""
+    line with noise). Two legs on the small model: a closed 32-request
+    batch (engine capacity) and an OPEN-LOOP Poisson-arrival run at a
+    quarter of that capacity (see the rate comment at the call site) with
+    per-request TTFT/latency percentiles — continuous
+    batching's value is admission under load, which a closed batch never
+    exercises (VERDICT r4 weak #2)."""
     import numpy as np
 
     import jax
@@ -231,25 +293,79 @@ def bench_serving():
                             prefill_bucket=128)
     eng.submit(rng.integers(0, cfg.vocab, 64), max_new=65)  # compile both
     eng.run()
+    eng.pop_request_metrics()
     n_req, max_new = 32, 64
     t0 = time.perf_counter()
     for _ in range(n_req):
         eng.submit(rng.integers(0, cfg.vocab, 64), max_new=max_new)
     eng.run()
     dt = time.perf_counter() - t0
+    eng.pop_request_metrics()
     out = {
         "serve_qps": round(n_req / dt, 2),
         "serve_decode_tok_s": round(n_req * max_new / dt, 0),
     }
+    # Open-loop capacity is readback-bound (~n_slots per step, one step per
+    # tunnel round trip), well below the closed-batch number — offer at a
+    # quarter of closed capacity so the queue is stable and the percentiles
+    # describe steady state, not an unbounded ramp.
+    out.update(_bench_serving_poisson(eng, cfg, rng, rate=out["serve_qps"] / 4))
     out.update(_bench_serving_int8())
+    out.update(_bench_serving_longctx())
+    out.update(_bench_serving_8b_full())
     return out
 
 
+def _bench_serving_poisson(eng, cfg, rng, rate: float, n_req: int = 48,
+                           prompt: int = 64, max_new: int = 64):
+    """Open-loop leg: submissions follow a Poisson process at ``rate``
+    req/s; the engine is driven by step() (per-step flush — tokens count
+    as delivered only when the host can see them, so the percentiles pay
+    the real per-chunk readback the closed batch's single drain hides)."""
+    import numpy as np
+
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_req))
+    done = {}
+    t0 = time.perf_counter()
+    submitted = 0
+    while len(done) < n_req:
+        now = time.perf_counter() - t0
+        while submitted < n_req and arrivals[submitted] <= now:
+            eng.submit(rng.integers(0, cfg.vocab, prompt), max_new=max_new)
+            submitted += 1
+        if eng.pending:
+            done.update(eng.step())
+        elif submitted < n_req:
+            time.sleep(min(0.005, arrivals[submitted] - now))
+    wall = time.perf_counter() - t0
+    stats = _latency_stats(eng.pop_request_metrics(), prefix="serve_poisson_")
+    stats["serve_poisson_offered_qps"] = round(rate, 2)
+    stats["serve_poisson_qps"] = round(n_req / wall, 2)
+    return stats
+
+
+def _wave_tok_s(eng, rng, vocab, n_req=8, max_new=256, prompt=64, waves=3):
+    """Best-of-N closed decode waves on a warmed engine — 256-token decodes
+    so chunks dispatch back-to-back and the one tunnel round trip per drain
+    amortizes; the number reflects device decode bandwidth."""
+    best = 0.0
+    for _ in range(waves):
+        t0 = time.perf_counter()
+        for _ in range(n_req):
+            eng.submit(rng.integers(0, vocab, prompt), max_new=max_new)
+        eng.run()
+        best = max(best, n_req * max_new / (time.perf_counter() - t0))
+    eng.pop_request_metrics()
+    return best
+
+
 def _bench_serving_int8():
-    """Weight-only int8 (ops/quant.py) vs bf16 at Llama-8B width, where
-    decode is HBM-bound on weight reads (at the small-model leg above the
-    tunnel round trip dominates and int8 shows nothing). One 8-request
-    wave per precision keeps the leg inside the bench's time budget."""
+    """Weight precision x KV-cache precision at Llama-8B WIDTH, 2 layers
+    (depth-truncated — the full-depth number is _bench_serving_8b_full's):
+    decode here is HBM-bound on WEIGHT reads (~0.9 GB int8 vs ~0.13 GB
+    cache per step at these shapes), so int8 weights show their gain and
+    the int8 KV cache shows only its small share — the cache-bound
+    complement is _bench_serving_longctx."""
     import numpy as np
 
     import jax
@@ -263,26 +379,136 @@ def _bench_serving_int8():
         d_ff=16384, max_seq=1024, remat=False,
     )
     params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quantize_llama_params(params)
     out = {}
-    for label, p in (("bf16", params),
-                     ("int8", quantize_llama_params(params))):
+    for label, p, kvd in (("bf16", params, None),
+                          ("int8", qparams, None),
+                          ("int8kv", qparams, "int8")):
         rng = np.random.default_rng(0)
         eng = ContinuousBatcher(p, cfg, n_slots=8, max_len=512, chunk=64,
-                                prefill_bucket=128)
+                                prefill_bucket=128, kv_dtype=kvd)
         eng.submit(rng.integers(0, cfg.vocab, 64), max_new=65)
         eng.run()                                    # compile both programs
-        best = 0.0
-        for _ in range(3):
-            t0 = time.perf_counter()
-            for _ in range(8):
-                # 256-token decodes: 4 chunks dispatch per drain, so the
-                # one tunnel round trip amortizes and the number reflects
-                # device decode bandwidth, which is what int8 halves.
-                eng.submit(rng.integers(0, cfg.vocab, 64), max_new=256)
-            eng.run()
-            best = max(best, 8 * 256 / (time.perf_counter() - t0))
-        out[f"serve_8b_tok_s_{label}"] = round(best, 0)
+        eng.pop_request_metrics()
+        out[f"serve_8b_tok_s_{label}"] = round(
+            _wave_tok_s(eng, rng, cfg.vocab), 0)
     return out
+
+
+def _bench_serving_longctx():
+    """Cache-bound decode: small weights (~70 MB bf16), 8 slots x 8192-row
+    cache — the dense decode attention reads the whole allocated cache
+    every token (~2.1 GB bf16 vs 0.14 GB weights), the long-context serving
+    regime where an int8 KV cache approaches 2x. Both variants run int8
+    weights so the delta isolates the cache."""
+    import numpy as np
+
+    import jax
+
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+    from k8s_gpu_scheduler_tpu.ops import quantize_llama_params
+
+    cfg = LlamaConfig(
+        vocab=32000, d_model=1024, n_layers=4, n_heads=16, n_kv_heads=16,
+        d_ff=4096, max_seq=8192, remat=False,
+    )
+    qparams = quantize_llama_params(init_params(cfg, jax.random.PRNGKey(0)))
+    out = {}
+    for label, kvd in (("bf16kv", None), ("int8kv", "int8")):
+        rng = np.random.default_rng(0)
+        eng = ContinuousBatcher(qparams, cfg, n_slots=8, max_len=8192,
+                                chunk=64, prefill_bucket=128, kv_dtype=kvd)
+        eng.submit(rng.integers(0, cfg.vocab, 64), max_new=65)
+        eng.run()
+        eng.pop_request_metrics()
+        out[f"serve_longctx_tok_s_{label}"] = round(
+            _wave_tok_s(eng, rng, cfg.vocab, waves=2), 0)
+    return out
+
+
+def _random_int8_llama_params(cfg, seed: int = 0):
+    """Random FULL-DEPTH int8 params built directly on device in quantized
+    form ({"q","s"} leaves, ops/quant.py layout): a real 8B never exists in
+    bf16 on a 16 GB chip next to its int8 copy, and the bench only needs
+    weight BYTES to be honest — values are irrelevant to fixed-budget
+    greedy throughput."""
+    import jax
+    import jax.numpy as jnp
+
+    D, H, Hkv, hd, F, L, V = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                              cfg.head_dim, cfg.d_ff, cfg.n_layers, cfg.vocab)
+
+    def build(key):
+        ks = jax.random.split(key, 10)
+
+        def q(k, *shape):
+            return {"q": jax.random.randint(k, shape, -127, 128, jnp.int8),
+                    "s": jnp.full(shape[:-2] + (1, shape[-1]), 0.01,
+                                  jnp.float32)}
+
+        return {
+            "embed": (jax.random.normal(ks[0], (V, D), jnp.float32)
+                      * 0.02).astype(cfg.dtype),
+            "blocks": {
+                "attn_norm": jnp.ones((L, D), cfg.dtype),
+                "wq": q(ks[1], L, D, H * hd),
+                "wk": q(ks[2], L, D, Hkv * hd),
+                "wv": q(ks[3], L, D, Hkv * hd),
+                "wo": q(ks[4], L, H * hd, D),
+                "mlp_norm": jnp.ones((L, D), cfg.dtype),
+                "w_gate": q(ks[5], L, D, F),
+                "w_up": q(ks[6], L, D, F),
+                "w_down": q(ks[7], L, F, D),
+            },
+            "final_norm": jnp.ones((D,), cfg.dtype),
+            "lm_head": q(ks[8], D, V),
+        }
+
+    return jax.jit(build)(jax.random.PRNGKey(seed))
+
+
+def _bench_serving_8b_full():
+    """FULL-DEPTH Llama-8B serving (VERDICT r4 #1): 32 layers, d_model
+    4096, GQA 4:1, the llama3_8b architecture — ~7.4 GB of int8 weights +
+    int8 KV cache, resident on the one 16 GB chip. Reports end-to-end
+    decode tok/s AND per-request TTFT/latency percentiles from a step()-
+    driven wave (per-step flush: tokens count when the host sees them)."""
+    import numpy as np
+
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+    cfg = LlamaConfig(
+        vocab=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq=1024, remat=False,
+    )
+    params = _random_int8_llama_params(cfg)
+    rng = np.random.default_rng(0)
+    eng = ContinuousBatcher(params, cfg, n_slots=8, max_len=512, chunk=32,
+                            prefill_bucket=128, kv_dtype="int8")
+    eng.submit(rng.integers(0, cfg.vocab, 64), max_new=33)   # compile
+    eng.run()
+    eng.pop_request_metrics()
+    n_req, max_new = 8, 128
+    # Latency wave: step()-driven, per-chunk flush — TTFT/p99 pay the real
+    # readback cadence a client would see.
+    for _ in range(n_req):
+        eng.submit(rng.integers(0, cfg.vocab, 64), max_new=max_new)
+    done = {}
+    while eng.pending:
+        done.update(eng.step())
+    stats = _latency_stats(eng.pop_request_metrics(), prefix="serve_8b_full_")
+    # Throughput wave: run()'s deferred readback (one round trip per
+    # drain), so tok/s reflects device decode bandwidth, not the tunnel.
+    t0 = time.perf_counter()
+    for _ in range(n_req):
+        eng.submit(rng.integers(0, cfg.vocab, 64), max_new=max_new)
+    eng.run()
+    wall = time.perf_counter() - t0
+    eng.pop_request_metrics()
+    stats["serve_8b_full_tok_s"] = round(n_req * max_new / wall, 0)
+    return stats
 
 
 def main():

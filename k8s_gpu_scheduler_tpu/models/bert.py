@@ -108,6 +108,9 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
     import os
     import time
 
+    from ..utils.enforcement import apply_env_limits
+
+    throttle = apply_env_limits()   # HBM cap + duty pacing (scheduler env)
     cfg = BertConfig.base()
     params = init_params(cfg, jax.random.PRNGKey(0))
     B, T = 32, 128
@@ -121,7 +124,10 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
     while True:
         t0 = time.perf_counter()
         infer(params, tokens).block_until_ready()
-        qps = B / (time.perf_counter() - t0)
+        step_dt = time.perf_counter() - t0
+        qps = B / step_dt
+        if throttle is not None:
+            throttle.pace(step_dt)
         print(f"bert-base qps={qps:.1f} slo={slo} "
               f"chips={os.environ.get('TPU_VISIBLE_CHIPS', '?')}", flush=True)
         if publish is not None:

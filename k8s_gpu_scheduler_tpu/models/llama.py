@@ -383,6 +383,14 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
     parser.add_argument("--ckpt-every", type=int, default=100)
     args = parser.parse_args()
 
+    # Enforce the scheduler-injected sharing limits BEFORE the backend
+    # initializes: XLA mem fraction from TPU_HBM_LIMIT_BYTES, host pacing
+    # from TPU_DUTY_CYCLE_PERCENTAGE (utils/enforcement.py — the MPS-env
+    # contract the reference gets from the CUDA runtime for free).
+    from ..utils.enforcement import apply_env_limits
+
+    throttle = apply_env_limits()
+
     from ..parallel import distributed_init_from_env
 
     # The injected TPU_WORKER_HOSTNAMES are pod-reachable addresses (stable
@@ -466,6 +474,10 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
 
             eng.submit(prompt_arr(), max_new=max_new + 1)
             eng.run()                                   # compile both
+            # Discard the warmup's latency record — it carries compile
+            # time (seconds through the remote tunnel), and the FIRST
+            # p99 published seeds the registry latency EWMA verbatim.
+            eng.pop_request_metrics()
             while True:
                 t0 = time.perf_counter()
                 n_req = 4 * n_slots
@@ -476,11 +488,22 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
                 # Count tokens actually emitted — with --eos-id, early-
                 # stopped requests decode fewer than max_new.
                 n_tok = sum(len(v) for v in done.values())
+                # Measured per-request latency (serving.py records it at
+                # flush): publish the wave's p99 so the collector folds it
+                # and the scheduler right-sizes against observed latency,
+                # not only predicted QPS.
+                lats = sorted(m["latency_s"] * 1000 for m in
+                              eng.pop_request_metrics().values())
+                p99 = lats[min(len(lats) - 1,
+                               round(0.99 * (len(lats) - 1)))] if lats else 0.0
                 print(f"llama serve qps={n_req / dt:.2f} "
                       f"decode_tok_s={n_tok / dt:.1f} "
-                      f"prefill_tok={n_req * Tp} slo={slo}", flush=True)
+                      f"prefill_tok={n_req * Tp} slo={slo} "
+                      f"p99_ms={p99:.1f}", flush=True)
                 if publish is not None:
-                    publish(n_req / dt)
+                    publish(n_req / dt, p99_ms=p99)
+                if throttle is not None:
+                    throttle.pace(dt)
                 # ~1 Hz pacing like the static loop: each publish is a
                 # registry GET (live neighbors) + SET — a fast wave must
                 # not turn one pod into a tens-of-Hz registry hammer.
@@ -515,6 +538,8 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
                   f"prefill_tok={b * Tp} slo={slo}", flush=True)
             if publish is not None:
                 publish(b / dt)
+            if throttle is not None:
+                throttle.pace(dt)
             time.sleep(max(0.0, 1.0 - dt))
     batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
     opt = optax.adamw(3e-4)
@@ -540,10 +565,13 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
         while True:
             t0 = time.perf_counter()
             params, state, loss = step(params, state, batch)
+            step_dt = time.perf_counter() - t0
             step_no += 1
-            tok_s = B * T / (time.perf_counter() - t0)
+            tok_s = B * T / step_dt
             print(f"llama pretrain worker={worker_id} step={step_no} "
                   f"tok/s={tok_s:.0f} loss={float(loss):.3f}", flush=True)
+            if throttle is not None:
+                throttle.pace(step_dt)
             if ckpt is not None:
                 ckpt.maybe_save(step_no, (params, state),
                                 every=args.ckpt_every)

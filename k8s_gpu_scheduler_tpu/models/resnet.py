@@ -127,6 +127,9 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
 
     import optax
 
+    from ..utils.enforcement import apply_env_limits
+
+    throttle = apply_env_limits()   # HBM cap + duty pacing (scheduler env)
     cfg = ResNetConfig.resnet50()
     params = init_params(cfg, jax.random.PRNGKey(0))
     B = 64
@@ -148,7 +151,10 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
         t0 = time.perf_counter()
         params, state, loss = step(params, state, batch)
         float(loss)
-        ips = B / (time.perf_counter() - t0)
+        step_dt = time.perf_counter() - t0
+        ips = B / step_dt
+        if throttle is not None:
+            throttle.pace(step_dt)
         print(f"resnet50 img/s={ips:.1f} loss={float(loss):.3f} slo={slo} "
               f"chips={os.environ.get('TPU_VISIBLE_CHIPS', '?')}", flush=True)
         # Feedback loop (recommender/collector.py), paced to ~1 Hz so a

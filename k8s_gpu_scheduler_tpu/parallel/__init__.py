@@ -9,12 +9,13 @@ attention built on XLA collectives over ICI (ppermute ring, all_to_all
 Ulysses) rather than NCCL/MPI.
 """
 from .distributed import distributed_init_from_env, worker_addresses
-from .mesh import MeshSpec, make_mesh, named_sharding
+from .mesh import MeshSpec, make_mesh, multislice_mesh, named_sharding
 from .sharding import logical_axis_rules, shard_params_spec
 
 __all__ = [
     "MeshSpec",
     "make_mesh",
+    "multislice_mesh",
     "named_sharding",
     "logical_axis_rules",
     "shard_params_spec",

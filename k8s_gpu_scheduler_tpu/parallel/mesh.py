@@ -56,6 +56,32 @@ class MeshSpec:
                          "tp": tp})
 
 
+def multislice_mesh(n_slices: int, fsdp: int = 1, sp: int = 1, tp: int = 1,
+                    ep: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh for a DCN-spanning gang (GKE multislice): the OUTER dp axis is
+    the slice index — its collectives (the data-parallel gradient
+    all-reduce) cross slices over DCN, while fsdp/sp/ep/tp stay inside each
+    slice's ICI. Device order must be SLICE-MAJOR (slice 0's devices first),
+    which is exactly the worker-id order the gang plugin injects
+    (plugins/gang.py post_bind sorts members slice-group-major, and
+    jax.devices() follows process ids). The standard multislice recipe: DP
+    between slices, model parallelism within — DCN bandwidth is orders of
+    magnitude below ICI, and DP's one all-reduce per step is the only
+    traffic that tolerates it. Built directly from the reshaped device
+    array, NOT mesh_utils (which optimizes for a single torus and would
+    interleave devices across the slice boundary)."""
+    devices = list(devices) if devices is not None else jax.devices()
+    per_slice = fsdp * sp * ep * tp
+    need = n_slices * per_slice
+    if need > len(devices):
+        raise ValueError(
+            f"multislice mesh {n_slices}x{per_slice} needs {need} devices, "
+            f"have {len(devices)}")
+    grid = np.array(devices[:need], dtype=object).reshape(
+        (n_slices, fsdp, sp, ep, tp))
+    return Mesh(grid, ("dp", "fsdp", "sp", "ep", "tp"))
+
+
 def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices) if devices is not None else jax.devices()
     if spec.size > len(devices):

@@ -16,6 +16,16 @@ plugin is the flagship new TPU capability (SURVEY.md §7.7, BASELINE config 4):
   label) with minimal added ICI torus diameter, using the worker-index label
   and the slice shape from ``host_coordinates`` (api/topology.py) — the
   locality the reference could not express with UUID strings.
+- **Multislice** (GKE-standard, VERDICT r4 missing #3): when NO single
+  slice group can host ``min_member`` hosts, the gang is allowed to span
+  groups — data parallelism's gradient all-reduce rides DCN between slices
+  while model parallelism stays on each slice's ICI
+  (parallel/mesh.py multislice_mesh: outer dp axis = slice index). Score
+  still packs members into as few groups as possible (every extra group is
+  an extra DCN edge), and PostBind additionally injects TPU_SLICE_ID /
+  TPU_NUM_SLICES / TPU_SLICE_HOSTNAMES so the workload can build the
+  slice-major mesh. The spanning decision is re-evaluated while the gang is
+  still confined to one group, and sticky once it actually spans.
 """
 from __future__ import annotations
 
@@ -72,6 +82,9 @@ class GangPlugin(
         # group key -> {pod uid -> node name}, reserved-but-not-yet-confirmed
         # AND bound members (pruned when the pod or group is deleted).
         self._assignments: Dict[str, Dict[str, str]] = {}
+        # Gangs allowed to span slice groups (no single group fits them) —
+        # see pre_filter. Pruned with the assignments.
+        self._multislice: set = set()
         # Prune bookkeeping when gang members disappear, so a re-created
         # gang under the same name starts from a clean count.
         self.handle.factory.informer("Pod").add_event_handler(
@@ -88,6 +101,7 @@ class GangPlugin(
             members.pop(pod.metadata.uid, None)
             if not members:
                 self._assignments.pop(key, None)
+                self._multislice.discard(key)
 
     # -- group lookup ------------------------------------------------------
     def _group_of(self, pod: Pod) -> Optional[PodGroup]:
@@ -119,14 +133,52 @@ class GangPlugin(
                 for info in self.handle.cache.snapshot().values()
                 if info.free_tpu >= chips
             )
+            key = self._key(group)
             with self._mu:
-                already = len(self._assignments.get(self._key(group), {}))
+                already = len(self._assignments.get(key, {}))
             if free_hosts + already < group.min_member:
                 return Status.unschedulable(
                     f"gang {name}: {free_hosts} candidate hosts + {already} "
                     f"reserved < min_member {group.min_member}"
                 )
+            self._update_multislice(group, chips)
         return Status.success()
+
+    def _update_multislice(self, group: PodGroup, chips: int) -> None:
+        """Decide (or re-decide) whether this gang may span slice groups:
+        spanning turns on when NO single group can host min_member members,
+        and heals back to single-slice only while the gang is still
+        confined to at most one group — once members actually sit in two
+        groups, flipping the flag would strand the rest at Filter."""
+        key = self._key(group)
+        with self._mu:
+            assigned_nodes = set(
+                self._assignments.get(key, {}).values())
+            flagged = key in self._multislice
+        spanning = len(self._slice_groups_of_nodes(assigned_nodes)) > 1
+        if flagged and spanning:
+            return
+        feasible = self._single_slice_feasible(group, chips, assigned_nodes)
+        with self._mu:
+            if feasible:
+                self._multislice.discard(key)
+            else:
+                self._multislice.add(key)
+
+    def _single_slice_feasible(self, group: PodGroup, chips: int,
+                               assigned_nodes: set) -> bool:
+        """Can ANY one slice group provide min_member hosts (counting the
+        gang's own reserved hosts as available in their group)?"""
+        per_group: Dict[str, int] = {}
+        for info in self.handle.cache.snapshot().values():
+            g = slice_group_of(info)
+            if info.name in assigned_nodes or info.free_tpu >= chips:
+                per_group[g] = per_group.get(g, 0) + 1
+        return any(n >= group.min_member for n in per_group.values())
+
+    def _is_multislice(self, group: PodGroup) -> bool:
+        with self._mu:
+            return self._key(group) in self._multislice
 
     @staticmethod
     def _key(group: PodGroup) -> str:
@@ -158,8 +210,10 @@ class GangPlugin(
                     f"slice shape {topo.dims} != gang topology {want.dims}"
                 )
         # All members ride one slice's ICI: once any member is reserved, the
-        # rest must share its slice group.
-        if assigned:
+        # rest must share its slice group — unless the gang is in
+        # multislice mode (no single group fits it; dp spans groups over
+        # DCN, Score still packs).
+        if assigned and not self._is_multislice(group):
             peer_groups = state.read("gang.peer_slice_groups")
             if peer_groups is None:
                 peer_groups = self._slice_groups_of_nodes(set(assigned.values()))
@@ -195,11 +249,36 @@ class GangPlugin(
             assigned = dict(self._assignments.get(self._key(group), {}))
         if not assigned:
             # First member: prefer low worker indices so gangs pack from the
-            # slice origin and leave contiguous room for the next gang.
-            return float(MAX_NODE_SCORE - min(worker_index_of(info), MAX_NODE_SCORE)), Status.success()
+            # slice origin and leave contiguous room for the next gang —
+            # but ONLY in a slice group that can actually host min_member
+            # members (a first member landing in a too-small group strands
+            # the gang there until the Permit timeout collapses it, then
+            # the retry can pick the same group forever).
+            base = float(
+                MAX_NODE_SCORE - min(worker_index_of(info), MAX_NODE_SCORE))
+            if not self._group_fits(state, pod, group, slice_group_of(info)):
+                base /= 4.0
+            return base, Status.success()
         # Later members: minimize added ICI hops to the reserved peers.
         # Distances are measured on the HOST grid (host_grid units), not chip
-        # dims — wraparound shortcuts exist at host granularity too.
+        # dims — wraparound shortcuts exist at host granularity too. In
+        # multislice mode only IN-GROUP peers have meaningful ICI distance;
+        # a node opening a NEW slice group scores at half scale (every
+        # extra group is an extra DCN edge — pack first, span only when
+        # packing is impossible).
+        if self._is_multislice(group):
+            mine_group = slice_group_of(info)
+            snap = self.handle.cache.snapshot()    # ONE copy per score call
+            in_group = {
+                uid: node for uid, node in assigned.items()
+                if (slice_group_of(snap[node]) if node in snap else "")
+                == mine_group
+            }
+            if not in_group:
+                base = float(
+                    MAX_NODE_SCORE - min(worker_index_of(info), MAX_NODE_SCORE))
+                return base / 2.0, Status.success()
+            assigned = in_group      # in-group peers: full-scale ICI scoring
         try:
             coords, grid = self._host_coords(topo)
         except ValueError:
@@ -214,7 +293,24 @@ class GangPlugin(
             for p in peers
         )
         worst = sum(grid) * max(len(peers), 1)
-        return max(0.0, MAX_NODE_SCORE * (1.0 - added / max(worst, 1))), Status.success()
+        score = max(0.0, MAX_NODE_SCORE * (1.0 - added / max(worst, 1)))
+        return score, Status.success()
+
+    def _group_fits(self, state: CycleState, pod: Pod, group: PodGroup,
+                    slice_group: str) -> bool:
+        """Can ``slice_group`` host min_member members? Candidate counts
+        are computed once per cycle (CycleState memo) — Score runs per
+        node."""
+        sizes = state.read("gang.group_candidates")
+        if sizes is None:
+            chips = pod.spec.tpu_chips()
+            sizes = {}
+            for info in self.handle.cache.snapshot().values():
+                if info.free_tpu >= chips:
+                    g = slice_group_of(info)
+                    sizes[g] = sizes.get(g, 0) + 1
+            state.write("gang.group_candidates", sizes)
+        return sizes.get(slice_group, 0) >= group.min_member
 
     @staticmethod
     def _host_coords(topo: SliceTopology):
@@ -363,15 +459,22 @@ class GangPlugin(
             assigned = dict(self._assignments.get(self._key(group), {}))
         if not assigned:
             return
-        # Deterministic worker ids: sort members by their host's worker-index
-        # label (falling back to node name) so every member derives the same
-        # order independently.
+        # Deterministic worker ids: sort members SLICE-GROUP-major, then by
+        # their host's worker-index label (falling back to node name), so
+        # every member derives the same order independently AND a
+        # multislice gang's ids are contiguous per slice — the slice-major
+        # device order multislice_mesh (parallel/mesh.py) expects, putting
+        # the outer dp axis across slices. Single-slice gangs sort exactly
+        # as before (one group).
         infos = {i.name: i for i in self.handle.cache.snapshot().values()}
-        members = sorted(
-            assigned.items(),
-            key=lambda kv: (
-                worker_index_of(infos[kv[1]]) if kv[1] in infos else 0, kv[1]),
-        )
+
+        def member_key(kv):
+            node = kv[1]
+            info = infos.get(node)
+            return (slice_group_of(info) if info is not None else "",
+                    worker_index_of(info) if info is not None else 0, node)
+
+        members = sorted(assigned.items(), key=member_key)
         ns, gname = pod.metadata.namespace, group.metadata.name
         try:
             peers = self.handle.factory.informer("Pod").list()
@@ -388,14 +491,30 @@ class GangPlugin(
         my_id = next(
             (i for i, (uid, _) in enumerate(members)
              if uid == pod.metadata.uid), 0)
-        self.handle.descriptor.append_to_pod_configmaps(
-            pod,
-            {
-                ENV_WORKER_ID: str(my_id),
-                ENV_WORKER_HOSTNAMES: ",".join(addresses),
-                "TPU_WORKER_COUNT": str(len(addresses)),
-            },
-        )
+        data = {
+            ENV_WORKER_ID: str(my_id),
+            ENV_WORKER_HOSTNAMES: ",".join(addresses),
+            "TPU_WORKER_COUNT": str(len(addresses)),
+        }
+        # Multislice gang: also inject the slice coordinates so the
+        # workload can build the slice-major mesh (outer dp over DCN) —
+        # pure functions of node labels + assignments, so every member
+        # derives the same values.
+        node_group = {
+            node: (slice_group_of(infos[node]) if node in infos else "")
+            for _, node in members
+        }
+        member_groups = sorted(set(node_group.values()))
+        if len(member_groups) > 1:
+            my_group = node_group.get(node_name, "")
+            slice_hosts = [
+                addr for (_, node), addr in zip(members, addresses)
+                if node_group[node] == my_group
+            ]
+            data["TPU_SLICE_ID"] = str(member_groups.index(my_group))
+            data["TPU_NUM_SLICES"] = str(len(member_groups))
+            data["TPU_SLICE_HOSTNAMES"] = ",".join(slice_hosts)
+        self.handle.descriptor.append_to_pod_configmaps(pod, data)
 
     @staticmethod
     def _member_address(peer: Optional[Pod], node_name: str) -> str:

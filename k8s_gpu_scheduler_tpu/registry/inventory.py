@@ -19,10 +19,20 @@ from typing import Dict, List, Optional
 NODE_KEY_PREFIX = "node/"          # node/<name>   -> NodeInventory JSON
 HEARTBEAT_SUFFIX = "/heartbeat"    # node/<name>/heartbeat -> unix ts
 OBSERVED_KEY_PREFIX = "observed/"  # observed/<workload>/<column> -> Observation
+LATENCY_KEY_PREFIX = "latency/"    # latency/<workload>/<column> -> p99 ms
 
 
 def node_key(node_name: str) -> str:
     return NODE_KEY_PREFIX + node_name
+
+
+def latency_key(workload: str, column: str) -> str:
+    """Collector-folded MEASURED p99 per (workload, partition size) — what
+    Score/rightsize consult so placement answers to observed latency, not
+    only predicted QPS (VERDICT r4 #3). Columns use the workload publisher's
+    chips-based convention ({chips}P_{GEN}) — both ends of this key are
+    owned by this codebase, so the convention is self-consistent."""
+    return f"{LATENCY_KEY_PREFIX}{workload}/{column}"
 
 
 def observed_key(workload: str, column: str, co_located: bool = False) -> str:
@@ -55,6 +65,11 @@ class Observation:
     qps: float         # observed throughput (requests/s or steps/s)
     at: float = 0.0    # unix ts of the sample
     neighbors: List[str] = field(default_factory=list)
+    # Measured per-request p99 latency (serving engines report it from
+    # ContinuousBatcher.pop_request_metrics); 0 = not measured. The
+    # collector folds it into latency/<workload>/<column> so the scheduler
+    # right-sizes against observed latency.
+    p99_ms: float = 0.0
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), sort_keys=True)
@@ -66,6 +81,7 @@ class Observation:
             workload=d.get("workload", ""), column=d.get("column", ""),
             qps=float(d.get("qps", 0.0)), at=float(d.get("at", 0.0)),
             neighbors=[str(n) for n in d.get("neighbors", [])],
+            p99_ms=float(d.get("p99_ms", 0.0)),
         )
 
 

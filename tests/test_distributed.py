@@ -136,3 +136,57 @@ class TestSelfWorkerId:
         assert self_worker_id(["10.0.0.1", "10.0.0.2"],
                               {"HOSTNAME": "llama-1"}) is None
         assert self_worker_id(self.ADDRS, {}) is None
+
+
+class TestMultisliceMesh:
+    """parallel/mesh.py multislice_mesh: slice-major device order, outer dp
+    axis = slice index (the DCN-spanning gang layout gang.py injects
+    TPU_SLICE_* env for)."""
+
+    def test_shape_and_slice_major_order(self):
+        import jax
+
+        from k8s_gpu_scheduler_tpu.parallel import multislice_mesh
+
+        mesh = multislice_mesh(2, fsdp=2, tp=2)
+        assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "sp": 1, "ep": 1,
+                                    "tp": 2}
+        devs = jax.devices()
+        # dp index 0 holds the FIRST per-slice block of devices, dp index 1
+        # the second — the slice boundary, not an interleave.
+        first_slice = mesh.devices[0].flatten().tolist()
+        second_slice = mesh.devices[1].flatten().tolist()
+        assert first_slice == devs[:4]
+        assert second_slice == devs[4:8]
+
+    def test_too_few_devices_rejected(self):
+        import pytest
+
+        from k8s_gpu_scheduler_tpu.parallel import multislice_mesh
+
+        with pytest.raises(ValueError, match="needs 16"):
+            multislice_mesh(4, fsdp=2, tp=2)
+
+    def test_train_step_runs_on_multislice_mesh(self):
+        """One full train step with dp spanning the slice boundary — the
+        gradient all-reduce is the only cross-slice collective (the
+        multislice contract)."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from k8s_gpu_scheduler_tpu.models import (
+            LlamaConfig, init_params, make_train_step,
+        )
+        from k8s_gpu_scheduler_tpu.parallel import multislice_mesh
+
+        mesh = multislice_mesh(2, tp=2)        # 2 slices x 2 chips
+        cfg = LlamaConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        opt = optax.adamw(1e-3)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    cfg.vocab)
+        batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+        step = make_train_step(cfg, mesh, opt)
+        _, _, loss = step(params, opt.init(params), batch)
+        assert jnp.isfinite(loss)
