@@ -220,6 +220,17 @@ def bench_train_mfu():
                 "step_deep_ms": round(dt_d * 1000, 1),
                 "tokens_per_s_deep": round(tok_d, 0),
                 "mfu_deep_pct": mfu_d,
+                # Model-FLOPs accounting (flops_per_token) excludes the
+                # remat recompute, and the deep model does not compile
+                # without remat (remote-compile memory budget — measured:
+                # B=4 remat=False fails, B=8/12 remat=True run). Full
+                # remat re-runs the forward once inside the backward:
+                # hardware FLOPs = model FLOPs x (fwd+bwd+fwd)/(fwd+bwd)
+                # = 4/3 exactly (attention included — its fwd share is
+                # the same 1/3). This line is the profile for the
+                # model-MFU gap: 54.6% model = ~73% of the MXU busy.
+                "mfu_deep_hw_pct": (round(mfu_d * 4 / 3, 2)
+                                    if mfu_d is not None else None),
             })
         except Exception as e:  # noqa: BLE001 — deep leg must not kill wide
             out["mfu_deep_error"] = str(e)[:200]

@@ -55,16 +55,7 @@ def pp_loss_fn(params: Dict, batch: Dict, cfg: LlamaConfig, mesh: Mesh,
     [B, T] with B divisible by ``microbatches``; layers (cfg.n_layers)
     must divide by the pp axis size."""
     n_stages = mesh.shape["pp"]
-    assert cfg.n_layers % n_stages == 0, (cfg.n_layers, n_stages)
-    if cfg.n_experts > 1:
-        raise NotImplementedError(
-            "pipeline parallelism does not compose with MoE configs yet "
-            "(route expert dispatch per stage); use dense layers")
-    if cfg.attn_impl != "dense":
-        raise NotImplementedError(
-            f"pipeline parallelism runs dense attention only (got "
-            f"attn_impl={cfg.attn_impl!r}); flash/ring/ulysses per stage "
-            f"is future work")
+    _check_pp_config(cfg, n_stages)
     M = microbatches
     B, T = batch["tokens"].shape
     assert B % M == 0, (B, M)
@@ -132,6 +123,181 @@ def pp_loss_fn(params: Dict, batch: Dict, cfg: LlamaConfig, mesh: Mesh,
               params["final_norm"], batch["tokens"], batch["targets"])
 
 
+def _check_pp_config(cfg: LlamaConfig, n_stages: int) -> None:
+    assert cfg.n_layers % n_stages == 0, (cfg.n_layers, n_stages)
+    if cfg.n_experts > 1:
+        raise NotImplementedError(
+            "pipeline parallelism does not compose with MoE configs yet "
+            "(route expert dispatch per stage); use dense layers")
+    if cfg.attn_impl != "dense":
+        raise NotImplementedError(
+            f"pipeline parallelism runs dense attention only (got "
+            f"attn_impl={cfg.attn_impl!r}); flash/ring/ulysses per stage "
+            f"is future work")
+
+
+def pp_1f1b_loss_and_grads(params: Dict, batch: Dict, cfg: LlamaConfig,
+                           mesh: Mesh, microbatches: int):
+    """(loss, grads) through a synchronous 1F1B schedule — the memory-side
+    successor to GPipe (pp_loss_fn):
+
+    - **Why**: autodiff-GPipe stashes every microbatch's scan-saved
+      activations until the reverse pass — O(M) live stashes per stage.
+      1F1B drains each microbatch's backward as soon as it can, so stage s
+      holds at most 2(P-1-s)+1 in-flight INPUT activations — O(P),
+      independent of M. Same total tick count (M + 2(P-1) combined-F/B
+      ticks vs GPipe's (M+P-1) forward + (M+P-1) backward); the win is
+      that the O(P) stash lets you raise M at fixed HBM, and M is what
+      divides the bubble down.
+    - **Schedule** (synchronous formulation): at tick t, stage s runs the
+      FORWARD of microbatch f = t - s and the BACKWARD of microbatch
+      b = t - 2(P-1) + s, both masked to [0, M). The backward of b reaches
+      stage s exactly one tick after stage s+1 emitted its cotangent
+      (b + 2(P-1) - (s+1) = t - 1), so activations ppermute forward and
+      cotangents ppermute backward every tick. On the last stage b == f:
+      loss cotangent is produced and consumed in the same tick, so the
+      last stage never stashes at all.
+    - **Backward is manual VJP + recompute**: no jax.value_and_grad over
+      the schedule — each backward tick re-runs the stage's forward inside
+      ``jax.vjp`` from the SAVED INPUT (rematerialization, same policy as
+      cfg.remat on the other paths). Invalid ticks contribute exactly
+      zero: cotangents are zeroed before the VJP and VJPs are linear in
+      the cotangent, so no separate masking of the parameter grads is
+      needed. Invalid forwards write their garbage into a dedicated
+      scratch stash slot (index W) so they can never clobber a live one.
+
+    Loss parity with GPipe/single-device is asserted in
+    tests/test_models.py."""
+    n_stages = mesh.shape["pp"]
+    _check_pp_config(cfg, n_stages)
+    if n_stages < 2:
+        raise ValueError("1F1B needs >= 2 stages; use the plain train step")
+    M = microbatches
+    B, T = batch["tokens"].shape
+    assert B % M == 0, (B, M)
+    mb = B // M
+    P_ = n_stages
+    W = 2 * (P_ - 1) + 1                    # max in-flight inputs (stage 0)
+    angles = rope_freqs(cfg.head_dim, T, cfg.rope_theta)
+    total_tokens = float(B * T)
+
+    def stage_program(blocks, embed, lm_head, final_norm, tokens, targets):
+        stage = jax.lax.axis_index("pp")
+        last = P_ - 1
+        tok_mb = tokens.reshape(M, mb, T)
+        tgt_mb = targets.reshape(M, mb, T)
+
+        def run_local(x, blk):
+            def one(x, layer):
+                return _block(cfg, x, layer, angles), None
+
+            one_fn = jax.checkpoint(one) if cfg.remat else one
+            x, _ = jax.lax.scan(one_fn, x, blk)
+            return x
+
+        def head_nll(y, lmh, fn, tgt):
+            h = rms_norm(y, fn)
+            logits = (h @ lmh).astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            hit = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+            return (lse - hit).sum()
+
+        zero_grads = (
+            jax.tree.map(jnp.zeros_like, blocks),
+            jnp.zeros_like(embed),
+            jnp.zeros_like(lm_head),
+            jnp.zeros_like(final_norm),
+        )
+        act0 = jnp.zeros((mb, T, cfg.d_model), cfg.dtype)
+        stash0 = jnp.zeros((W + 1, mb, T, cfg.d_model), cfg.dtype)
+
+        def tick(carry, t):
+            act_in, cot_in, stash, grads, loss_sum = carry
+            gblocks, gembed, glmh, gfn = grads
+            f = t - stage                          # fwd microbatch index
+            b = t - 2 * (P_ - 1) + stage           # bwd microbatch index
+            valid_f = (f >= 0) & (f < M)
+            valid_b = (b >= 0) & (b < M)
+            fc = jnp.clip(f, 0, M - 1)
+            bc = jnp.clip(b, 0, M - 1)
+
+            # ---- forward of microbatch f -------------------------------
+            inject = embed[tok_mb[fc]].astype(cfg.dtype)
+            x_in = jnp.where(stage == 0, inject, act_in)
+            slot_f = jnp.where(valid_f, fc % W, W)   # scratch slot if invalid
+            stash = jax.lax.dynamic_update_slice_in_dim(
+                stash, x_in[None], slot_f, axis=0)
+            y = run_local(x_in, blocks)
+
+            # ---- last stage: loss + its cotangent (b == f here) --------
+            cot_scale = jnp.where(valid_f & (stage == last),
+                                  1.0 / total_tokens, 0.0)
+            nll, head_vjp = jax.vjp(
+                lambda yy, lmh, fn: head_nll(yy, lmh, fn, tgt_mb[fc]),
+                y, lm_head, final_norm)
+            dy_head, dlmh, dfn = head_vjp(cot_scale.astype(jnp.float32))
+            loss_sum = loss_sum + jnp.where(
+                valid_f & (stage == last), nll, 0.0)
+            glmh = glmh + dlmh.astype(glmh.dtype)
+            gfn = gfn + dfn.astype(gfn.dtype)
+
+            # ---- backward of microbatch b ------------------------------
+            x_saved = jnp.where(
+                stage == last, x_in,
+                jax.lax.dynamic_index_in_dim(stash, bc % W, axis=0,
+                                             keepdims=False))
+            dy = jnp.where(stage == last, dy_head.astype(cfg.dtype),
+                           cot_in * valid_b.astype(cot_in.dtype))
+            _, local_vjp = jax.vjp(run_local, x_saved, blocks)
+            dx, dblocks = local_vjp(dy)
+            gblocks = jax.tree.map(
+                lambda g, d: g + d.astype(g.dtype), gblocks, dblocks)
+            # Stage 0 folds dx into the embedding gradient — mask the SMALL
+            # dx by the scalar and scatter straight into the accumulator
+            # (scatter is linear; a zeros_like temporary would cost three
+            # full-vocab passes per tick).
+            emb_mask = jnp.where(valid_b & (stage == 0), 1.0, 0.0)
+            gembed = gembed.at[tok_mb[bc]].add(
+                (dx * emb_mask.astype(dx.dtype)).astype(gembed.dtype))
+
+            # ---- ring movement -----------------------------------------
+            act_out = jax.lax.ppermute(
+                y, "pp", [(i, (i + 1) % P_) for i in range(P_)])
+            cot_out = jax.lax.ppermute(
+                dx, "pp", [(i, (i - 1) % P_) for i in range(P_)])
+            return (act_out, cot_out, stash,
+                    (gblocks, gembed, glmh, gfn), loss_sum), None
+
+        (_, _, _, grads, loss_sum), _ = jax.lax.scan(
+            tick,
+            (act0, act0, stash0, zero_grads, jnp.zeros((), jnp.float32)),
+            jnp.arange(M + 2 * (P_ - 1)))
+        gblocks, gembed, glmh, gfn = grads
+        loss = jax.lax.psum(loss_sum, "pp") / total_tokens
+        # Replicated-param grads: each stage holds only its own (zero
+        # elsewhere) contribution — psum sums them into the replicated
+        # gradient.
+        gembed = jax.lax.psum(gembed, "pp")
+        glmh = jax.lax.psum(glmh, "pp")
+        gfn = jax.lax.psum(gfn, "pp")
+        return loss, gblocks, gembed, glmh, gfn
+
+    blocks_spec = jax.tree.map(lambda _: P("pp"), params["blocks"])
+    fn = jax.shard_map(
+        stage_program,
+        mesh=mesh,
+        in_specs=(blocks_spec, P(), P(), P(), P(), P()),
+        out_specs=(P(), blocks_spec, P(), P(), P()),
+        check_vma=False,
+    )
+    loss, gblocks, gembed, glmh, gfn = fn(
+        params["blocks"], params["embed"], params["lm_head"],
+        params["final_norm"], batch["tokens"], batch["targets"])
+    grads = {"blocks": gblocks, "embed": gembed, "lm_head": glmh,
+             "final_norm": gfn}
+    return loss, grads
+
+
 def pp_param_shardings(cfg: LlamaConfig, mesh: Mesh) -> Dict:
     """NamedShardings for the pipeline layout: block leaves split their
     leading layer axis over pp, the rest replicate. Block keys come from
@@ -152,14 +318,26 @@ def pp_param_shardings(cfg: LlamaConfig, mesh: Mesh) -> Dict:
 
 
 def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer,
-                       microbatches: int):
+                       microbatches: int, schedule: str = "gpipe"):
     """Jitted pipeline train step: (params, opt_state, batch) →
     (params, opt_state, loss). Layer shards stay resident on their stage
     across steps (in_shardings pin them), so the optimizer update for a
-    stage's layers also runs on that stage."""
+    stage's layers also runs on that stage.
+
+    ``schedule``: "gpipe" (autodiff through the forward schedule, O(M)
+    activation stash) or "1f1b" (manual-VJP synchronous 1F1B, O(P) stash —
+    pp_1f1b_loss_and_grads). Loss/grad equivalence between the two is
+    asserted in tests and the pp dryrun leg."""
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule must be 'gpipe' or '1f1b', got {schedule!r}")
+
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(pp_loss_fn)(
-            params, batch, cfg, mesh, microbatches)
+        if schedule == "1f1b":
+            loss, grads = pp_1f1b_loss_and_grads(
+                params, batch, cfg, mesh, microbatches)
+        else:
+            loss, grads = jax.value_and_grad(pp_loss_fn)(
+                params, batch, cfg, mesh, microbatches)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(
             lambda p, u: p + u.astype(p.dtype), params, updates)
