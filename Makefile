@@ -5,7 +5,7 @@
 PY ?= python
 IMG_TAG ?= 0.1.0
 
-.PHONY: all native test bench demo images install uninstall clean
+.PHONY: all native test e2e bench demo images install uninstall clean
 
 all: native test
 
@@ -15,6 +15,12 @@ native:
 
 test: native
 	$(PY) -m pytest tests/
+
+# All-real smoke: kvstored + tpuprobe agents + gRPC recommender + fakekube
+# + scheduler booted together; a gang and an SLO singleton scheduled
+# through every real seam at once (tests/test_e2e.py).
+e2e: native
+	$(PY) -m pytest tests/test_e2e.py -q
 
 bench:
 	$(PY) bench.py

@@ -26,6 +26,24 @@ BASELINE_P50_MS = 100.0
 PEAK_TFLOPS = {"v5 lite": 197.0, "v5p": 459.0, "v4": 275.0, "v6": 918.0}
 
 
+class MemRegistry:
+    """In-memory inventory source for the bench legs (the live kvstored is
+    benched separately by its own tests; here the registry must not add
+    noise to the scheduler numbers)."""
+
+    def __init__(self):
+        self.data = {}
+
+    def get(self, key):
+        return self.data.get(key)
+
+    def set(self, key, value):
+        self.data[key] = value
+
+    def get_keys(self, pattern="*"):
+        return [k for k in self.data if k.startswith(pattern.rstrip("*"))]
+
+
 def bench_schedule_churn(n_nodes=16, n_pods=64, rest=False, suffix=None):
     """Pod churn through the full plugin pipeline. ``rest=False`` drives
     the in-memory APIServer (pure framework overhead); ``rest=True`` drives
@@ -45,16 +63,6 @@ def bench_schedule_churn(n_nodes=16, n_pods=64, rest=False, suffix=None):
     from k8s_gpu_scheduler_tpu.plugins import TPUPlugin
     from k8s_gpu_scheduler_tpu.registry.inventory import NodeInventory, node_key
     from k8s_gpu_scheduler_tpu.sched import Profile, Scheduler
-
-    class MemRegistry:
-        def __init__(self):
-            self.data = {}
-
-        def get(self, key):
-            return self.data.get(key)
-
-        def get_keys(self, pattern="*"):
-            return [k for k in self.data if k.startswith(pattern.rstrip("*"))]
 
     fake_proc = None
     if rest:
@@ -136,6 +144,195 @@ def bench_schedule_churn(n_nodes=16, n_pods=64, rest=False, suffix=None):
         if fake_proc is not None:
             fake_proc.terminate()
             fake_proc.wait(timeout=5)
+
+
+def bench_mixed(n_nodes=1024, n_single=560, n_gangs=30, rate=150.0):
+    """Adversarial scale leg (VERDICT r4 #5): 1024 nodes over REST under a
+    MIXED Poisson workload — 560 singletons of varied chip counts, 30
+    four-member gangs (slice groups of 4 hosts), a 2-node hot zone
+    saturated by low-priority fillers that higher-priority preemptors then
+    evict, and one node mid-reshape the whole time. At drain, assert chip
+    accounting is ZERO-SUM (every node's bound chips <= capacity, the
+    scheduler's own cache agrees with the API state, the fillers are gone)
+    and report the scheduler's p50/p99 under that load. The homogeneous
+    churn legs above can't surface cross-workload pathologies (the
+    reference's O(pods x uuids) hot-loop RPCs only showed under mixed
+    load, SURVEY.md §3.2)."""
+    import subprocess
+
+    import numpy as np
+
+    from k8s_gpu_scheduler_tpu.api.objects import (
+        ANN_RESHAPE_STATE, ConfigMap, ConfigMapRef, Container, ObjectMeta,
+        Pod, PodGroup, PodSpec, ResourceRequirements, TPU_RESOURCE,
+    )
+    from k8s_gpu_scheduler_tpu.cluster.kubeapi import KubeAPIServer
+    from k8s_gpu_scheduler_tpu.config import SchedulerConfig
+    from k8s_gpu_scheduler_tpu.plugins import (
+        GangPlugin, PreemptionPlugin, TPUPlugin,
+    )
+    from k8s_gpu_scheduler_tpu.registry.inventory import (
+        NodeInventory, node_key,
+    )
+    from k8s_gpu_scheduler_tpu.sched import Profile, Scheduler
+
+    fake_proc = subprocess.Popen(
+        [sys.executable, "-m", "tests.fakekube", "--nodes", str(n_nodes),
+         "--slice-size", "4", "--hot-nodes", "2"],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        port_line = fake_proc.stdout.readline().strip()
+        assert port_line.startswith("PORT "), port_line
+        server = KubeAPIServer(
+            base_url=f"http://127.0.0.1:{port_line.split()[1]}")
+        reg = MemRegistry()
+        for i in range(n_nodes):
+            reg.data[node_key(f"v5e-{i}")] = NodeInventory(
+                node_name=f"v5e-{i}", utilization=(i % 10) / 10.0).to_json()
+
+        # A reshape in flight: this node must be skipped by every Filter
+        # for the entire run (the annotation is never cleared).
+        def mark(n):
+            n.metadata.annotations[ANN_RESHAPE_STATE] = "applying"
+
+        server.mutate("Node", "v5e-37", "default", mark)
+
+        sched = Scheduler(
+            server, profile=Profile(),
+            # 10% node sampling: the operational knob kube operators turn
+            # at this fleet size (percentageOfNodesToScore) — the adaptive
+            # default still scores ~42% of 1024 nodes per pod, and the
+            # p99 budget is spent walking nodes that can't win anyway.
+            config=SchedulerConfig(backoff_initial_s=0.05, backoff_max_s=0.5,
+                                   percentage_of_nodes_to_score=10),
+        )
+        tpu = TPUPlugin(sched.handle, registry=reg)
+        gang = GangPlugin(sched.handle)
+        profile = Profile(
+            pre_filter=[tpu, gang], filter=[tpu, gang], score=[tpu, gang],
+            reserve=[tpu, gang], permit=[gang], post_bind=[tpu, gang],
+        )
+        profile.post_filter.append(PreemptionPlugin(
+            sched.handle, filter_plugins=[tpu, gang], tpu=tpu))
+        sched.profile = profile
+
+        def submit(name, chips, selector=None, priority=None, group=None,
+                   owner=None):
+            server.create(ConfigMap(metadata=ObjectMeta(name=f"cm-{name}"),
+                                    data={}))
+            ann = {"tpu.sched/priority": str(priority)} if priority else {}
+            labels = {"tpu.sched/pod-group": group} if group else {}
+            server.create(Pod(
+                metadata=ObjectMeta(
+                    name=name, labels=labels, annotations=ann,
+                    # Victims must have a controller owner (preemption.py
+                    # never evicts bare pods — they'd be gone forever).
+                    owner_references=[owner] if owner else []),
+                spec=PodSpec(
+                    node_selector=selector or {},
+                    containers=[Container(
+                        env_from=[ConfigMapRef(f"cm-{name}")],
+                        resources=ResourceRequirements(
+                            requests={TPU_RESOURCE: chips}),
+                    )],
+                ),
+            ))
+
+        hist = sched.metrics.histogram("tpu_sched_e2e_duration_seconds")
+        sched.start()
+
+        # Phase A: saturate the hot zone BEFORE the storm, so the
+        # preemptors later have no free hot capacity.
+        for i in range(2):
+            submit(f"filler-{i}", 8, selector={"zone": "hot"},
+                   owner="StatefulSet/fillers")
+        deadline = time.time() + 30
+        while time.time() < deadline and hist.count < 2:
+            time.sleep(0.02)
+        assert hist.count == 2, f"fillers not placed: {hist.count}"
+
+        # Phase B: the Poisson storm — singletons + gangs interleaved.
+        rng = np.random.default_rng(0)
+        chip_mix = [1, 2, 4, 8]
+        events = [("single", i) for i in range(n_single)]
+        gang_slots = sorted(rng.choice(len(events), n_gangs, replace=False),
+                            reverse=True)
+        for j, pos in enumerate(gang_slots):
+            events.insert(pos, ("gang", j))
+        t0 = time.perf_counter()
+        arrival = 0.0
+        for kind, idx in events:
+            arrival += rng.exponential(1.0 / rate)
+            lag = arrival - (time.perf_counter() - t0)
+            if lag > 0:
+                time.sleep(lag)
+            if kind == "single":
+                submit(f"job-{idx}", chip_mix[idx % 4])
+            else:
+                server.create(PodGroup(
+                    metadata=ObjectMeta(name=f"gang-{idx}"), min_member=4,
+                    topology="", schedule_timeout_s=60.0))
+                for m in range(4):
+                    submit(f"gang-{idx}-{m}", 8, group=f"gang-{idx}")
+
+        # Phase C: the preemptors — higher priority, hot zone only.
+        for i in range(2):
+            submit(f"preemptor-{i}", 8, selector={"zone": "hot"},
+                   priority=100)
+
+        total_binds = 2 + n_single + 4 * n_gangs + 2
+        deadline = time.time() + 180
+        while time.time() < deadline and hist.count < total_binds:
+            time.sleep(0.05)
+        wall = time.perf_counter() - t0
+        bound = hist.count
+        sched.stop()
+
+        # ---- zero-sum accounting at drain ------------------------------
+        pods = server.list("Pod")
+        by_node = {}
+        for p in pods:
+            if p.spec.node_name:
+                by_node[p.spec.node_name] = (
+                    by_node.get(p.spec.node_name, 0) + p.spec.tpu_chips())
+        overcommit = [n for n, c in by_node.items() if c > 8]
+        unbound = [p.metadata.name for p in pods if not p.spec.node_name]
+        fillers_left = [p.metadata.name for p in pods
+                        if p.metadata.name.startswith("filler-")]
+        reshaping_used = by_node.get("v5e-37", 0)
+        cache_drift = []
+        for name, info in sched.cache.snapshot().items():
+            want = by_node.get(name, 0)
+            have = sum(p.spec.tpu_chips() for p in info.pods)
+            if want != have:
+                cache_drift.append((name, want, have))
+        zero_sum = (not overcommit and not unbound and not fillers_left
+                    and reshaping_used == 0 and not cache_drift)
+        # Two latency views, mirroring kube-scheduler's metric split:
+        # e2e (cycle start -> bind) INCLUDES gang Permit quorum wait — a
+        # 4-member gang's first member cannot bind before its peers'
+        # cycles have run, so its e2e measures workload shape. The cycle
+        # histogram is the per-attempt SCHEDULER work (Filter->Permit),
+        # the number the <50 ms bound is about.
+        cyc = sched.metrics.histogram("tpu_sched_scheduling_cycle_seconds")
+        return {
+            "mixed1024_p50_ms": round((hist.quantile(0.5) or 0) * 1000, 3),
+            "mixed1024_p99_ms": round((hist.quantile(0.99) or 0) * 1000, 3),
+            "mixed1024_cycle_p50_ms": round(
+                (cyc.quantile(0.5) or 0) * 1000, 3),
+            "mixed1024_cycle_p99_ms": round(
+                (cyc.quantile(0.99) or 0) * 1000, 3),
+            "mixed1024_binds": bound,
+            "mixed1024_expected_binds": total_binds,
+            "mixed1024_pods_per_s": round(bound / wall, 1),
+            "mixed1024_preempted": 2 - len(fillers_left),
+            "mixed1024_zero_sum": zero_sum,
+        }
+    finally:
+        fake_proc.terminate()
+        fake_proc.wait(timeout=5)
 
 
 def _mfu_one(cfg, B, T, steps):
@@ -559,6 +756,11 @@ def main():
     except Exception as e:  # noqa: BLE001
         churn_256 = {"rest256_error": str(e)[:200]}
     try:
+        # Adversarial mixed load at 1024 nodes (VERDICT r4 #5).
+        mixed = bench_mixed()
+    except Exception as e:  # noqa: BLE001
+        mixed = {"mixed1024_error": str(e)[:200]}
+    try:
         train = bench_train_mfu()
     except Exception as e:  # noqa: BLE001 — accelerator part must not kill the line
         train = {"error": str(e)[:200]}
@@ -572,7 +774,8 @@ def main():
         "value": churn["p50_ms"],
         "unit": "ms",
         "vs_baseline": round(BASELINE_P50_MS / p50, 2),
-        "extra": {**churn, **churn_rest, **churn_256, **train, **serve},
+        "extra": {**churn, **churn_rest, **churn_256, **mixed, **train,
+                  **serve},
     }))
 
 

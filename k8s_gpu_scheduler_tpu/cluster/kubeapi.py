@@ -94,6 +94,24 @@ def _meta_to(m: ObjectMeta, namespaced: bool) -> Dict:
                            "annotations": m.annotations}
     if namespaced:
         out["namespace"] = m.namespace
+    if m.owner_references:
+        # Inverse of _meta_from's "Kind/name" flattening — without this a
+        # pod CREATED through this adapter silently loses its controller
+        # reference, and both preemption victim eligibility and the gang
+        # bare-pod eviction guard key on having one. The model keeps only
+        # kind+name, so the emitted refs are the create-side minimum
+        # (apiVersion inferred for the common controller kinds); callers
+        # that PATCH must strip the key (see mutate) — merge-patch would
+        # REPLACE a real apiserver's full refs (uid, controller flags)
+        # with this reduced form.
+        api_of = {"StatefulSet": "apps/v1", "Deployment": "apps/v1",
+                  "ReplicaSet": "apps/v1", "DaemonSet": "apps/v1",
+                  "Job": "batch/v1"}
+        out["ownerReferences"] = [
+            {"apiVersion": api_of.get(r.split("/", 1)[0], "v1"),
+             "kind": r.split("/", 1)[0], "name": r.split("/", 1)[-1]}
+            for r in m.owner_references
+        ]
     return out
 
 
@@ -484,6 +502,12 @@ class KubeAPIServer:
             )
             return current
         body = obj_to_json(current)
+        # ownerReferences are read-only through this adapter: a merge-PATCH
+        # carrying the model's reduced kind/name form would REPLACE the
+        # apiserver's full refs (uid, controller, blockOwnerDeletion) and
+        # break garbage collection — and on a strict server 422 for the
+        # missing uid. Omitting the key leaves the server's refs untouched.
+        body.get("metadata", {}).pop("ownerReferences", None)
         if kind == "Node":
             # only metadata is ours to change on nodes (labels/annotations)
             body = {"metadata": body["metadata"]}

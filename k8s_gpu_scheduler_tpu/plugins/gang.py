@@ -128,11 +128,12 @@ class GangPlugin(
         # assume chips pod by pod and thrash the cluster.
         chips = pod.spec.tpu_chips()
         if chips > 0:
+            # ONE snapshot pass serves the capacity check AND the
+            # multislice decision (at 1024 nodes, repeated O(nodes) scans
+            # per gang-member cycle were the mixed-load p99 tail).
+            snap = self._cycle_infos(state)
             free_hosts = sum(
-                1
-                for info in self.handle.cache.snapshot().values()
-                if info.free_tpu >= chips
-            )
+                1 for info in snap.values() if info.free_tpu >= chips)
             key = self._key(group)
             with self._mu:
                 already = len(self._assignments.get(key, {}))
@@ -141,10 +142,21 @@ class GangPlugin(
                     f"gang {name}: {free_hosts} candidate hosts + {already} "
                     f"reserved < min_member {group.min_member}"
                 )
-            self._update_multislice(group, chips)
+            self._update_multislice(group, chips, snap)
         return Status.success()
 
-    def _update_multislice(self, group: PodGroup, chips: int) -> None:
+    def _cycle_infos(self, state: CycleState) -> Dict[str, NodeInfo]:
+        """The node snapshot, taken ONCE per scheduling cycle (CycleState
+        memo): snapshot() walks every node under the cache lock, and the
+        gang plugin needs it from PreFilter, Filter, and per-node Score."""
+        infos = state.read("gang.cycle_infos")
+        if infos is None:
+            infos = self.handle.cache.snapshot()
+            state.write("gang.cycle_infos", infos)
+        return infos
+
+    def _update_multislice(self, group: PodGroup, chips: int,
+                           snap: Dict[str, NodeInfo]) -> None:
         """Decide (or re-decide) whether this gang may span slice groups:
         spanning turns on when NO single group can host min_member members,
         and heals back to single-slice only while the gang is still
@@ -155,10 +167,11 @@ class GangPlugin(
             assigned_nodes = set(
                 self._assignments.get(key, {}).values())
             flagged = key in self._multislice
-        spanning = len(self._slice_groups_of_nodes(assigned_nodes)) > 1
+        spanning = len(self._slice_groups_of_nodes(assigned_nodes, snap)) > 1
         if flagged and spanning:
             return
-        feasible = self._single_slice_feasible(group, chips, assigned_nodes)
+        feasible = self._single_slice_feasible(group, chips, assigned_nodes,
+                                               snap)
         with self._mu:
             if feasible:
                 self._multislice.discard(key)
@@ -166,11 +179,12 @@ class GangPlugin(
                 self._multislice.add(key)
 
     def _single_slice_feasible(self, group: PodGroup, chips: int,
-                               assigned_nodes: set) -> bool:
+                               assigned_nodes: set,
+                               snap: Dict[str, NodeInfo]) -> bool:
         """Can ANY one slice group provide min_member hosts (counting the
         gang's own reserved hosts as available in their group)?"""
         per_group: Dict[str, int] = {}
-        for info in self.handle.cache.snapshot().values():
+        for info in snap.values():
             g = slice_group_of(info)
             if info.name in assigned_nodes or info.free_tpu >= chips:
                 per_group[g] = per_group.get(g, 0) + 1
@@ -216,7 +230,8 @@ class GangPlugin(
         if assigned and not self._is_multislice(group):
             peer_groups = state.read("gang.peer_slice_groups")
             if peer_groups is None:
-                peer_groups = self._slice_groups_of_nodes(set(assigned.values()))
+                peer_groups = self._slice_groups_of_nodes(
+                    set(assigned.values()), self._cycle_infos(state))
                 state.write("gang.peer_slice_groups", peer_groups)
             mine = slice_group_of(info)
             if peer_groups and mine not in peer_groups:
@@ -225,10 +240,16 @@ class GangPlugin(
                 )
         return Status.success()
 
-    def _slice_groups_of_nodes(self, node_names) -> set:
+    def _slice_groups_of_nodes(self, node_names,
+                               snap: Dict[str, NodeInfo]) -> set:
+        """Slice groups of the named nodes — O(members) dict lookups, not a
+        fleet scan (the snapshot is name-keyed; snap is REQUIRED so no
+        caller can silently regress to one cache-lock snapshot per call,
+        the 1024-node p99 tail)."""
         groups = set()
-        for info in self.handle.cache.snapshot().values():
-            if info.name in node_names:
+        for name in node_names:
+            info = snap.get(name)
+            if info is not None:
                 g = slice_group_of(info)
                 if g:
                     groups.add(g)
@@ -266,9 +287,9 @@ class GangPlugin(
         # a node opening a NEW slice group scores at half scale (every
         # extra group is an extra DCN edge — pack first, span only when
         # packing is impossible).
+        snap = self._cycle_infos(state)
         if self._is_multislice(group):
             mine_group = slice_group_of(info)
-            snap = self.handle.cache.snapshot()    # ONE copy per score call
             in_group = {
                 uid: node for uid, node in assigned.items()
                 if (slice_group_of(snap[node]) if node in snap else "")
@@ -283,7 +304,7 @@ class GangPlugin(
             coords, grid = self._host_coords(topo)
         except ValueError:
             return 0.0, Status.success()
-        peers = self._peer_indices(assigned)
+        peers = self._peer_indices(assigned, snap)
         mine = worker_index_of(info)
         if mine >= len(coords) or any(p >= len(coords) for p in peers):
             return 0.0, Status.success()
@@ -305,7 +326,7 @@ class GangPlugin(
         if sizes is None:
             chips = pod.spec.tpu_chips()
             sizes = {}
-            for info in self.handle.cache.snapshot().values():
+            for info in self._cycle_infos(state).values():
                 if info.free_tpu >= chips:
                     g = slice_group_of(info)
                     sizes[g] = sizes.get(g, 0) + 1
@@ -318,10 +339,15 @@ class GangPlugin(
 
         return host_coordinates(topo.dims, topo.gen), host_grid(topo.dims, topo.gen)
 
-    def _peer_indices(self, assigned: Dict[str, str]) -> List[int]:
+    def _peer_indices(self, assigned: Dict[str, str],
+                      snap: Dict[str, NodeInfo]) -> List[int]:
+        """Worker indices of the reserved peers — O(members) lookups (this
+        runs once per SCORED NODE; a fleet scan here was part of the
+        1024-node mixed-load p99 tail)."""
         out = []
-        for info in self.handle.cache.snapshot().values():
-            if info.name in assigned.values():
+        for node in assigned.values():
+            info = snap.get(node)
+            if info is not None:
                 out.append(worker_index_of(info))
         return out
 
@@ -466,7 +492,7 @@ class GangPlugin(
         # device order multislice_mesh (parallel/mesh.py) expects, putting
         # the outer dp axis across slices. Single-slice gangs sort exactly
         # as before (one group).
-        infos = {i.name: i for i in self.handle.cache.snapshot().values()}
+        infos = self.handle.cache.snapshot()        # already name-keyed
 
         def member_key(kv):
             node = kv[1]

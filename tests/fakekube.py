@@ -300,10 +300,23 @@ def main() -> None:  # pragma: no cover — bench.py subprocess entrypoint
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=0)
     ap.add_argument("--chips", type=int, default=8)
+    # Group nodes into slice groups of K hosts (tpu.sched/slice-group +
+    # worker-index labels) so gang workloads can run over REST — bench.py's
+    # mixed1024 leg uses this.
+    ap.add_argument("--slice-size", type=int, default=0)
+    # Label the first N nodes zone=hot: a scarce pool the mixed leg
+    # saturates with low-priority fillers so preemptors have work to do.
+    ap.add_argument("--hot-nodes", type=int, default=0)
     args = ap.parse_args()
     fake = FakeKube()
     for i in range(args.nodes):
-        fake.add_node(f"v5e-{i}", chips=args.chips)
+        labels = {}
+        if args.slice_size:
+            labels["tpu.sched/slice-group"] = f"sg-{i // args.slice_size}"
+            labels["tpu.sched/worker-index"] = str(i % args.slice_size)
+        if i < args.hot_nodes:
+            labels["zone"] = "hot"
+        fake.add_node(f"v5e-{i}", chips=args.chips, labels=labels)
     print(f"PORT {fake.server.server_port}", flush=True)
     threading.Event().wait()
 

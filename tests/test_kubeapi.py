@@ -35,6 +35,18 @@ class TestAdapter:
         assert pod.spec.containers[0].env_from[0].name == "cm-a"
         assert [p.metadata.name for p in api.list("Pod")] == ["p1"]
 
+    def test_owner_references_roundtrip(self, fake):
+        """ownerReferences must survive create→get: preemption victim
+        eligibility and the gang bare-pod guard both key on a pod having a
+        controller — a drop here silently disables preemption for every
+        pod created through this adapter (found by bench_mixed)."""
+        from tests.test_plugins import mk_pod
+
+        api = KubeAPIServer(base_url=fake.url)
+        api.create(mk_pod("owned", chips=1, owner="StatefulSet/web"))
+        pod = api.get("Pod", "owned", "default")
+        assert pod.metadata.owner_references == ["StatefulSet/web"]
+
     def test_node_mapping(self, fake):
         fake.add_node("n1", chips=4)
         api = KubeAPIServer(base_url=fake.url)
