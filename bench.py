@@ -608,7 +608,20 @@ def _bench_serving_longctx():
     cache — the dense decode attention reads the whole allocated cache
     every token (~2.1 GB bf16 vs 0.14 GB weights), the long-context serving
     regime where an int8 KV cache approaches 2x. Both variants run int8
-    weights so the delta isolates the cache."""
+    weights so the delta isolates the cache.
+
+    Measured gain is 1.3-1.4x, not the 2x byte ratio — profile (r5): the
+    in-engine per-token-step cost (~20 ms at B8/S8192/H16/d64/L4) is ~15x
+    the theoretical cache-read time (1.3 ms at 819 GB/s), so decode is NOT
+    purely cache-bandwidth-bound: the masked dense attention materializes
+    f32 score/prob tensors ([B,H,1,S] each, written+read around the
+    softmax) and the scan-carried cache update costs aliasing traffic —
+    none of which int8 shrinks. Cache layout ([B,S,H,hd] vs [B,H,S,hd])
+    measures identical; kernel-level microbenches through the axon tunnel
+    are floored at ~4.6 ms/dispatch and cannot resolve further. The real
+    fix is a fused Pallas decode-attention kernel (single pass, scores in
+    registers/VMEM) — future work; the flash kernels in
+    ops/flash_attention.py cover the training shapes only."""
     import numpy as np
 
     import jax
