@@ -610,18 +610,16 @@ def _bench_serving_longctx():
     regime where an int8 KV cache approaches 2x. Both variants run int8
     weights so the delta isolates the cache.
 
-    Measured gain is 1.3-1.4x, not the 2x byte ratio — profile (r5): the
-    in-engine per-token-step cost (~20 ms at B8/S8192/H16/d64/L4) is ~15x
-    the theoretical cache-read time (1.3 ms at 819 GB/s), so decode is NOT
-    purely cache-bandwidth-bound: the masked dense attention materializes
-    f32 score/prob tensors ([B,H,1,S] each, written+read around the
-    softmax) and the scan-carried cache update costs aliasing traffic —
-    none of which int8 shrinks. Cache layout ([B,S,H,hd] vs [B,H,S,hd])
-    measures identical; kernel-level microbenches through the axon tunnel
-    are floored at ~4.6 ms/dispatch and cannot resolve further. The real
-    fix is a fused Pallas decode-attention kernel (single pass, scores in
-    registers/VMEM) — future work; the flash kernels in
-    ops/flash_attention.py cover the training shapes only."""
+    Round-5 profile: the dense masked attention's measured gain was
+    1.3-1.4x, not the 2x byte ratio — the per-token step materialized f32
+    score/prob planes and re-read the repeated GQA cache copy, none of
+    which int8 shrinks. The identified fix was a fused Pallas
+    decode-attention kernel; this round ships it
+    (ops/decode_attention.py), so the leg now runs each cache dtype
+    through BOTH decode paths (`*_fused` rows = LlamaConfig.decode_attn
+    "fused"), and `bench_decode_attention` isolates the kernel itself."""
+    import dataclasses
+
     import numpy as np
 
     import jax
@@ -636,16 +634,108 @@ def _bench_serving_longctx():
     )
     qparams = quantize_llama_params(init_params(cfg, jax.random.PRNGKey(0)))
     out = {}
-    for label, kvd in (("bf16kv", None), ("int8kv", "int8")):
+    for label, kvd, impl in (("bf16kv", None, "dense"),
+                             ("int8kv", "int8", "dense"),
+                             ("bf16kv_fused", None, "fused"),
+                             ("int8kv_fused", "int8", "fused")):
         rng = np.random.default_rng(0)
-        eng = ContinuousBatcher(qparams, cfg, n_slots=8, max_len=8192,
-                                chunk=64, prefill_bucket=128, kv_dtype=kvd)
+        eng = ContinuousBatcher(
+            qparams, dataclasses.replace(cfg, decode_attn=impl), n_slots=8,
+            max_len=8192, chunk=64, prefill_bucket=128, kv_dtype=kvd)
         eng.submit(rng.integers(0, cfg.vocab, 64), max_new=65)
         eng.run()
         eng.pop_request_metrics()
         out[f"serve_longctx_tok_s_{label}"] = round(
             _wave_tok_s(eng, rng, cfg.vocab, waves=2), 0)
+    try:
+        out.update(bench_decode_attention()["extra"])
+    except Exception as e:  # noqa: BLE001 — microbench must not kill the leg
+        out["decattn_error"] = str(e)[:200]
     return out
+
+
+def bench_decode_attention(smoke=False):
+    """Decode-attention microbench — the kernel trajectory line for the
+    serving engine's hot path: dense grouped einsum vs the fused Pallas
+    flash-decode kernel (ops/decode_attention.py), bf16 cache vs int8-KV
+    ({int8 rows, f32 per-row scale} from serving._kv_quant). Reports
+    tokens/s per variant plus the cache bytes a step must move, so the
+    dense-vs-fused delta can be read against the bandwidth bound. On CPU
+    (or --smoke) the kernel runs in interpret mode at toy shapes — the
+    numbers there only prove the leg runs end-to-end; the TPU run under
+    the driver is what BENCH_*.json captures."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_scheduler_tpu.models.serving import _kv_quant
+    from k8s_gpu_scheduler_tpu.ops import (
+        dense_decode_reference, flash_decode_attention,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if smoke or not on_tpu:
+        B, H, Hkv, hd, S, iters = 2, 8, 4, 64, 256, 2
+    else:
+        # The long-context serving regime (_bench_serving_longctx's shape
+        # family, GQA 4:1): the cache read dominates every other byte.
+        B, H, Hkv, hd, S, iters = 8, 32, 8, 128, 8192, 30
+    fill = S - 1                                     # near-full cache
+    kq_, kk_, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq_, (B, H, hd), jnp.bfloat16)
+    k = jax.random.normal(kk_, (B, S, Hkv, hd), jnp.bfloat16)
+    v = jax.random.normal(kv_, (B, S, Hkv, hd), jnp.bfloat16)
+    k8, ks = _kv_quant(k)
+    v8, vs = _kv_quant(v)
+    lengths = jnp.full((B,), fill, jnp.int32)
+
+    legs = {
+        "dense_bf16": (jax.jit(
+            lambda q, k, v, n: dense_decode_reference(q, k, v, lengths=n)),
+            (q, k, v, lengths)),
+        "fused_bf16": (jax.jit(
+            lambda q, k, v, n: flash_decode_attention(q, k, v, n)),
+            (q, k, v, lengths)),
+        "dense_int8kv": (jax.jit(
+            lambda q, k, v, n, s1, s2: dense_decode_reference(
+                q, k, v, lengths=n, k_scale=s1, v_scale=s2)),
+            (q, k8, v8, lengths, ks, vs)),
+        "fused_int8kv": (jax.jit(
+            lambda q, k, v, n, s1, s2: flash_decode_attention(
+                q, k, v, n, k_scale=s1, v_scale=s2)),
+            (q, k8, v8, lengths, ks, vs)),
+    }
+    # K+V rows a dense step reads (the irreducible decode traffic; the
+    # fused kernel's length mask cuts it to fill/S of this).
+    bytes_bf16 = 2 * B * S * Hkv * hd * 2
+    bytes_int8 = 2 * B * S * Hkv * (hd * 1 + 4)
+    extra = {
+        "decattn_shape": f"B{B} H{H} Hkv{Hkv} hd{hd} S{S} fill{fill}",
+        "decattn_interpret": not on_tpu,
+        "decattn_bytes_per_step_bf16": bytes_bf16,
+        "decattn_bytes_per_step_int8kv": bytes_int8,
+    }
+    for name, (fn, args) in legs.items():
+        out = fn(*args)
+        jax.block_until_ready(out)                   # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        extra[f"decattn_{name}_tok_s"] = round(B / dt, 1)
+        nbytes = bytes_int8 if "int8" in name else bytes_bf16
+        extra[f"decattn_{name}_gb_s"] = round(nbytes / dt / 1e9, 1)
+    for kvd in ("bf16", "int8kv"):
+        dense = extra[f"decattn_dense_{kvd}_tok_s"]
+        fused = extra[f"decattn_fused_{kvd}_tok_s"]
+        extra[f"decattn_speedup_{kvd}"] = round(fused / dense, 2) \
+            if dense else None
+    return {
+        "metric": "decode_attention_microbench",
+        "value": extra["decattn_fused_int8kv_tok_s"],
+        "unit": "tok/s",
+        "extra": extra,
+    }
 
 
 def _random_int8_llama_params(cfg, seed: int = 0):
@@ -732,7 +822,20 @@ def _bench_serving_8b_full():
     return stats
 
 
-def main():
+def main(argv=None):
+    args = list(sys.argv[1:] if argv is None else argv)
+    if "--leg" in args:
+        # Single-leg mode: one JSON line for the named leg only (used by
+        # the decode-attention smoke test and for kernel iteration without
+        # paying the full scheduler/train/serve line).
+        idx = args.index("--leg") + 1
+        leg = args[idx] if idx < len(args) else None
+        if leg == "decode_attention":
+            print(json.dumps(bench_decode_attention(
+                smoke="--smoke" in args)))
+            return
+        raise SystemExit(f"unknown bench leg: {leg!r} "
+                         f"(available: decode_attention)")
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
     # the bench measures the scheduler as deployed.
     sys.setswitchinterval(0.001)

@@ -39,6 +39,13 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     dtype: Any = jnp.bfloat16
     attn_impl: str = "dense"  # dense | ring | ulysses | flash (pallas)
+    # Serving decode-attention path (models/serving.py): "fused" streams
+    # the KV cache through the Pallas flash-decode kernel
+    # (ops/decode_attention.py — in-kernel GQA, fused int8-KV dequant,
+    # O(pos) length-masked reads); "dense" keeps the grouped-einsum
+    # reference. Fused falls back to dense automatically when the cache
+    # length has no legal blocking, t > 1, or the cache is mesh-sharded.
+    decode_attn: str = "dense"
     remat: bool = True
     # Mixture-of-Experts (ops/moe.py): n_experts 0 = dense FFN; > 1 swaps
     # every layer's SwiGLU for top-k routed experts sharded over the ep

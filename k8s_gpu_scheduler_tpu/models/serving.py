@@ -15,6 +15,14 @@ second — VERDICT.md weak #3) with a real inference path:
   ``generate`` constrains it when a mesh is passed, so multi-chip serving
   shards the cache instead of replicating it.
 
+Decode attention itself has two implementations (``LlamaConfig.
+decode_attn``): the grouped-einsum dense path (no ``_repeat_kv``
+materialization — GQA contracts through a [B, Hkv, g, ...] head-group
+axis) and the fused Pallas flash-decode kernel
+(``ops/decode_attention.py``: block-streamed cache reads, in-kernel GQA,
+fused int8-KV dequant, O(pos) length-masked traffic, split-K), with
+automatic fallback to dense wherever the kernel doesn't apply.
+
 On top of the static path: ``ContinuousBatcher`` (slot admission between
 decode chunks, batched one-dispatch prefill with a bucket ladder for long
 prompts, deferred readbacks, EOS early-stop, temperature/top-k sampling,
@@ -37,7 +45,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.attention import _repeat_kv
+from ..ops.decode_attention import (
+    decode_plan, dense_decode_reference, flash_decode_attention,
+)
 from ..ops.layers import apply_rope, rms_norm, rope_freqs
 from ..ops.quant import qdot
 from .llama import LlamaConfig, _constrain, mlp_sublayer
@@ -61,23 +71,38 @@ def init_cache(cfg: LlamaConfig, batch: int,
 
 
 def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-                     pos: jax.Array) -> jax.Array:
+                     pos: jax.Array, impl: str = "dense",
+                     interpret: Optional[bool] = None) -> jax.Array:
     """Attention of q [B, t, H, hd] (absolute positions pos..pos+t-1)
     against the cache [B, S, Hkv, hd], masked to entries < pos+t with
-    causal order inside the new window. Dense over S — decode is a
-    [1, S]·[S, hd] matvec, bandwidth-bound by the cache read, which is the
-    irreducible cost."""
+    causal order inside the new window.
+
+    ``impl="fused"`` routes the decode shape (t == 1) through the Pallas
+    flash-decode kernel (ops/decode_attention.py): cache rows stream
+    through VMEM once with in-kernel GQA and blocks past ``pos`` skipped,
+    so the step costs O(pos) HBM traffic instead of O(max_seq). Shapes the
+    kernel's blocking cannot cover — and every t > 1 call (prefill,
+    speculative verify) — fall back automatically to the dense path, which
+    contracts through a grouped [B, Hkv, g, ...] head axis rather than
+    materializing an H/Hkv-times `_repeat_kv` copy of the cache."""
     b, t, n_heads, d = q.shape
-    s = k_cache.shape[1]
-    k = _repeat_kv(k_cache, n_heads)
-    v = _repeat_kv(v_cache, n_heads)
+    s, h_kv = k_cache.shape[1], k_cache.shape[2]
+    if impl == "fused" and t == 1 and n_heads % h_kv == 0 \
+            and decode_plan(s) is not None:
+        out = flash_decode_attention(
+            q[:, 0], k_cache, v_cache, pos + 1, interpret=interpret)
+        return out[:, None]
+    g = n_heads // h_kv
+    qg = q.reshape(b, t, h_kv, g, d)
     scale = 1.0 / (d ** 0.5)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k_cache).astype(jnp.float32) * scale
     q_pos = pos + jnp.arange(t)[:, None]          # [t, 1] absolute
     k_pos = jnp.arange(s)[None, :]                # [1, S]
     scores = jnp.where(k_pos <= q_pos, scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v_cache)
+    return out.reshape(b, t, n_heads, d)
 
 
 def forward_with_cache(
@@ -94,6 +119,11 @@ def forward_with_cache(
     the training forward wherever training didn't drop."""
     B, t = tokens.shape
     pos = cache["len"]
+    # Fused Pallas decode attention only off-mesh: pallas_call does not
+    # partition under GSPMD, so sharded caches keep the dense einsum path
+    # (XLA shards it like any other activation).
+    attn_impl = getattr(cfg, "decode_attn", "dense") if mesh is None \
+        else "dense"
     angles = jax.lax.dynamic_slice_in_dim(
         rope_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta), pos, t, 0)
     x = params["embed"][tokens].astype(cfg.dtype)
@@ -108,7 +138,7 @@ def forward_with_cache(
         q, k = apply_rope(q, angles), apply_rope(k, angles)
         k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
         v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
-        attn = cached_attention(q, k_cache, v_cache, pos)
+        attn = cached_attention(q, k_cache, v_cache, pos, impl=attn_impl)
         x = x + qdot(attn.reshape(B, t, cfg.n_heads * cfg.head_dim), blk["wo"])
         x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
         return x, (k_cache, v_cache)
@@ -338,6 +368,12 @@ def _decode_chunk_fn(params, cfg: LlamaConfig, chunk: int,
     quant = k_s is not None
     B = last.shape[0]
     S = k.shape[2]
+    # Fused Pallas decode kernel (ops/decode_attention.py) when the config
+    # asks for it, the cache is unsharded (pallas_call does not partition
+    # under GSPMD) and the blocking covers S; else the grouped dense
+    # reference — EITHER way no _repeat_kv materialization.
+    fused = (getattr(cfg, "decode_attn", "dense") == "fused"
+             and mesh is None and decode_plan(S) is not None)
     angles_full = rope_freqs(cfg.head_dim, S, cfg.rope_theta)
     col = jnp.arange(S)[None, :]
     base_key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
@@ -349,7 +385,6 @@ def _decode_chunk_fn(params, cfg: LlamaConfig, chunk: int,
         bitmap = bitmap | ((col == cursor) & active[:, None])
         x = params["embed"][last[:, None]].astype(cfg.dtype)   # [B, 1, D]
         angles = angles_full[rope_pos][:, None, :]             # [B, 1, hd/2]
-        kmask = bitmap[:, None, None, :]                       # [B,1,1,S]
 
         def block(x, layer):
             blk, k_cache, v_cache, ks_c, vs_c = layer          # [B,S,Hkv,hd]
@@ -358,7 +393,6 @@ def _decode_chunk_fn(params, cfg: LlamaConfig, chunk: int,
             kk = qdot(h, blk["wk"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
             vv = qdot(h, blk["wv"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
             q, kk = apply_rope(q, angles), apply_rope(kk, angles)
-            scale = 1.0 / (cfg.head_dim ** 0.5)
             if quant:
                 kq, ksn = _kv_quant(kk)
                 vq, vsn = _kv_quant(vv)
@@ -370,38 +404,28 @@ def _decode_chunk_fn(params, cfg: LlamaConfig, chunk: int,
                     ks_c, ksn, cursor, axis=1)
                 vs_c = jax.lax.dynamic_update_slice_in_dim(
                     vs_c, vsn, cursor, axis=1)
-                # The per-row scale is constant along the contracted hd
-                # axis, so factor it OUT of the einsums: scale the SCORES
-                # by k's row scales and the PROBS by v's — [B,H,1,S] work
-                # instead of [B,S,H,hd], a head_dim-fold cut in dequant
-                # VPU time (elementwise dequant of the full cache measured
-                # as ~half the int8 gain at S=8192). The int8→dtype
-                # convert fuses into the einsum's cache read, so HBM
-                # traffic stays int8.
-                kr = _repeat_kv(k_cache.astype(q.dtype), cfg.n_heads)
-                vr = _repeat_kv(v_cache.astype(q.dtype), cfg.n_heads)
-                ks_r = _repeat_kv(ks_c, cfg.n_heads)[..., 0]   # [B,S,H]
-                vs_r = _repeat_kv(vs_c, cfg.n_heads)[..., 0]
-                scores = jnp.einsum(
-                    "bqhd,bkhd->bhqk", q, kr).astype(jnp.float32) * scale
-                scores = scores * jnp.swapaxes(ks_r, 1, 2)[:, :, None, :]
-                scores = jnp.where(kmask, scores, _NEG_INF)
-                probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-                pv = probs * jnp.swapaxes(
-                    vs_r, 1, 2)[:, :, None, :].astype(q.dtype)
-                attn = jnp.einsum("bhqk,bkhd->bqhd", pv, vr)
             else:
                 k_cache = jax.lax.dynamic_update_slice_in_dim(
                     k_cache, kk, cursor, axis=1)
                 v_cache = jax.lax.dynamic_update_slice_in_dim(
                     v_cache, vv, cursor, axis=1)
-                kr = _repeat_kv(k_cache, cfg.n_heads)
-                vr = _repeat_kv(v_cache, cfg.n_heads)
-                scores = jnp.einsum(
-                    "bqhd,bkhd->bhqk", q, kr).astype(jnp.float32) * scale
-                scores = jnp.where(kmask, scores, _NEG_INF)
-                probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-                attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
+            scales = dict(k_scale=ks_c, v_scale=vs_c) if quant else {}
+            if fused:
+                # Streamed-block kernel: the cursor bounds every valid bit
+                # (the row written above is at `cursor`), so blocks past
+                # cursor+1 are skipped — O(filled rows), not O(S); the
+                # bitmap still masks exactly per slot inside the window.
+                attn = flash_decode_attention(
+                    q[:, 0], k_cache, v_cache, cursor + 1, bitmap=bitmap,
+                    **scales)
+            else:
+                # Grouped dense reference: per-row scales factor onto
+                # scores/probs ([B,Hkv,g,S] work instead of [B,S,H,hd] —
+                # a head_dim-fold cut in dequant VPU time), and the int8→
+                # dtype convert fuses into the einsum's cache read, so HBM
+                # traffic stays int8.
+                attn = dense_decode_reference(
+                    q[:, 0], k_cache, v_cache, bitmap=bitmap, **scales)
             x = x + qdot(attn.reshape(B, 1, cfg.n_heads * cfg.head_dim),
                          blk["wo"])
             x, _ = mlp_sublayer(cfg, x, blk, dropless=True)

@@ -4,15 +4,47 @@ Everything here is jit-traceable with static shapes, keeps the FLOPs in
 large bf16 matmuls (MXU-shaped), and uses `lax` control flow only. The
 sequence-parallel attention variants (ring via ppermute, Ulysses via
 all_to_all) are the long-context capability SURVEY.md §5 requires the
-rebuild to treat as first-class.
+rebuild to treat as first-class; the Pallas kernels (flash training
+attention, fused flash-decode serving attention) are the single-chip hot
+paths.
 """
-from .layers import apply_rope, rms_norm, rope_freqs, swiglu
-from .attention import dense_attention, ring_attention, ulysses_attention
-from .flash_attention import flash_attention, flash_attention_diff
-from .moe import load_balancing_loss, moe_ffn, moe_ffn_dropless
-from .quant import dequantize_weight, qdot, quantize_llama_params, quantize_weight
+import os
+
+import jax
+
+
+def pallas_interpret(override=None) -> bool:
+    """Shared interpret-mode toggle for every Pallas kernel in ops/.
+
+    Resolution order: an explicit ``override`` (the kernel wrapper's
+    ``interpret=`` argument) wins; else the ``TPU_SCHED_PALLAS_INTERPRET``
+    env var (config.py's TPU_SCHED_* convention — "1"/"true" forces
+    interpret even on TPU, "0" forces compiled; set-but-empty counts as
+    unset, so a bare `ENV TPU_SCHED_PALLAS_INTERPRET=` in a manifest can't
+    force compiled mode on a CPU host); else interpret exactly when the
+    backend is not a TPU, so tier-1 (JAX_PLATFORMS=cpu) exercises every
+    kernel hermetically instead of skipping them. Kernel modules import
+    this lazily (inside their wrappers) to stay cycle-free.
+    """
+    if override is not None:
+        return bool(override)
+    env = os.environ.get("TPU_SCHED_PALLAS_INTERPRET", "").strip()
+    if env:
+        return env.lower() not in ("0", "false", "no")
+    return jax.devices()[0].platform != "tpu"
+
+
+from .layers import apply_rope, rms_norm, rope_freqs, swiglu  # noqa: E402
+from .attention import dense_attention, ring_attention, ulysses_attention  # noqa: E402
+from .flash_attention import flash_attention, flash_attention_diff  # noqa: E402
+from .decode_attention import (  # noqa: E402
+    decode_plan, dense_decode_reference, flash_decode_attention,
+)
+from .moe import load_balancing_loss, moe_ffn, moe_ffn_dropless  # noqa: E402
+from .quant import dequantize_weight, qdot, quantize_llama_params, quantize_weight  # noqa: E402
 
 __all__ = [
+    "pallas_interpret",
     "qdot",
     "quantize_weight",
     "dequantize_weight",
@@ -26,6 +58,9 @@ __all__ = [
     "ulysses_attention",
     "flash_attention",
     "flash_attention_diff",
+    "decode_plan",
+    "dense_decode_reference",
+    "flash_decode_attention",
     "moe_ffn",
     "moe_ffn_dropless",
     "load_balancing_loss",

@@ -1,0 +1,11 @@
+"""Shared Pallas compatibility bits for the kernel modules.
+
+Kept out of ops/__init__.py (which hosts the user-facing
+``pallas_interpret`` toggle) so kernel modules can import it at module
+level without depending on package-init ordering.
+"""
+from jax.experimental.pallas import tpu as pltpu
+
+# jax renamed TPUCompilerParams -> CompilerParams; support both.
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")

@@ -1,0 +1,334 @@
+"""Fused flash-decode attention — Pallas TPU kernel for the serving engine.
+
+Decode attention is the KV-cache read: one query token per sequence against
+a [B, S, Hkv, hd] cache. The dense formulation (serving.py round 5) was
+bandwidth-HONEST about the irreducible cache read but wasteful around it:
+
+- `_repeat_kv` materialized an H/Hkv-times bf16 copy of K and V in HBM
+  every emitted token (GQA groups re-read `g` times);
+- the int8 cache was dequantized through full-width [B, S, H, hd] einsum
+  operands instead of inside the read;
+- the read was dense over the PREALLOCATED S rows, O(max_seq) regardless
+  of how little of the cache a request has filled;
+- the masked softmax round-tripped f32 score/prob planes through HBM
+  (profiled in bench.py's long-context leg as the bulk of the 15x gap
+  between measured step time and theoretical cache-read time).
+
+This kernel is the Flash-Decoding / vLLM-TPU shape instead:
+
+- **grid (batch x kv_head, split, kv_block)**: each program streams its
+  kv blocks through VMEM once, keeping flash-style running (m, l, acc)
+  stats in scratch — scores never exist in HBM;
+- **in-kernel GQA**: the query block is the whole [g = H/Hkv, hd] head
+  group served by this kv head, so each cache row is read ONCE and the
+  MXU contracts it against all g query heads — no repeated copy;
+- **fused int8-KV dequant**: K/V blocks are DMA'd as int8 (plus the f32
+  per-row scale plane from serving._kv_quant) and dequantized in
+  registers after the VMEM load — HBM traffic stays int8;
+- **traced length mask**: `lengths` rides as a scalar-prefetch operand;
+  blocks past a sequence's filled prefix are compute-skipped with
+  `pl.when` AND their BlockSpec index maps clamp to the last valid block,
+  so the pipeline re-visits a resident block instead of streaming dead
+  rows — cache traffic is O(pos), not O(max_seq);
+- **split-K + log-sum-exp combine**: the sequence is cut into `n_splits`
+  independent sweeps (parallel grid dim) whose partial (acc, m, l) are
+  combined outside the kernel with the standard LSE merge — long contexts
+  expose parallelism beyond B x Hkv cores.
+
+`dense_decode_reference` is the grouped-einsum dense formulation of the
+SAME contract (no `_repeat_kv` materialization either) — the numerical
+reference the kernel is tested against and the automatic fallback for
+shapes the blocking cannot cover. Both run under `JAX_PLATFORMS=cpu` via
+interpret mode (the shared `ops.pallas_interpret` toggle), so tier-1
+exercises the kernel hermetically.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._pallas import CompilerParams as _CompilerParams
+
+_NEG_INF = -1e30
+_LANES = 128
+
+
+def decode_plan(s: int, block_k: Optional[int] = None,
+                n_splits: Optional[int] = None) -> Optional[Tuple[int, int]]:
+    """Legal (block_k, n_splits) for a cache of S rows, or None when no
+    power-of-two block divides S (the caller falls back to the dense
+    reference — raggedness lives in the length mask, so only the ALLOCATED
+    S must divide). Splits engage at >= 8 blocks: below that the extra
+    partial outputs cost more than the parallelism buys."""
+    if block_k is None:
+        for cand in (256, 128, 64, 32, 16, 8):
+            if s % cand == 0:
+                block_k = cand
+                break
+        else:
+            return None
+    elif s % block_k:
+        return None
+    n_blocks = s // block_k
+    if n_splits is None:
+        n_splits = 1
+        if n_blocks >= 8:
+            for cand in (8, 4, 2):
+                if n_blocks % cand == 0:
+                    n_splits = cand
+                    break
+    elif n_blocks % n_splits:
+        return None
+    return block_k, n_splits
+
+
+def _mask_from(lengths, bitmap, s):
+    cols = jnp.arange(s)[None, :]                        # [1, S]
+    mask = None
+    if lengths is not None:
+        mask = cols < jnp.asarray(lengths, jnp.int32)[:, None]
+    if bitmap is not None:
+        mask = bitmap if mask is None else jnp.logical_and(mask, bitmap)
+    return mask
+
+
+def dense_decode_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                           lengths=None, k_scale=None, v_scale=None,
+                           bitmap=None) -> jax.Array:
+    """Grouped-einsum dense decode attention: q [B, H, hd] against the full
+    cache [B, S, Hkv, hd] → [B, H, hd]. GQA contracts through a [B, Hkv,
+    g, ...] head-group axis — no `_repeat_kv` copy. int8-KV mode
+    (`k_scale`/`v_scale` [B, S, Hkv, 1] from serving._kv_quant) factors
+    the per-row scales out of the contractions — scores scale by k's rows,
+    probs by v's — so dequant work is O(S), not O(S·hd), and the int8→
+    dtype convert fuses into the einsum's cache read. Masking: `lengths`
+    [B] keeps rows < length, `bitmap` [B, S] keeps set rows; both given =
+    AND. A fully-masked row softmaxes uniform (garbage — callers only mask
+    everything for slots whose output is never read)."""
+    b, n_heads, hd = q.shape
+    s, h_kv = k.shape[1], k.shape[2]
+    if n_heads % h_kv:
+        raise ValueError(
+            f"GQA needs n_heads ({n_heads}) divisible by kv heads ({h_kv})")
+    g = n_heads // h_kv
+    quant = k_scale is not None
+    scale = 1.0 / math.sqrt(hd)
+    qg = q.reshape(b, h_kv, g, hd)
+    kf = k.astype(q.dtype) if quant else k
+    scores = jnp.einsum("bhgd,bkhd->bhgk", qg, kf).astype(jnp.float32) * scale
+    if quant:
+        # [B, S, Hkv, 1] -> [B, Hkv, 1, S]: constant along hd, so it
+        # factors out of the contraction onto the scores.
+        scores = scores * jnp.transpose(k_scale[..., 0], (0, 2, 1))[:, :, None]
+    mask = _mask_from(lengths, bitmap, s)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if quant:
+        probs = probs * jnp.transpose(
+            v_scale[..., 0], (0, 2, 1))[:, :, None].astype(q.dtype)
+        vf = v.astype(q.dtype)
+    else:
+        vf = v
+    out = jnp.einsum("bhgk,bkhd->bhgd", probs, vf)
+    return out.reshape(b, n_heads, hd)
+
+
+# -- kernel -------------------------------------------------------------------
+
+def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, *rest, scale: float,
+                   block_k: int, n_kv: int, bps: int, quant: bool,
+                   with_bitmap: bool):
+    if quant:
+        ks_ref, vs_ref, *rest = rest
+    if with_bitmap:
+        bm_ref, *rest = rest
+    o_ref, mo_ref, lo_ref, acc_ref, m_ref, l_ref = rest
+
+    bh = pl.program_id(0)
+    split = pl.program_id(1)
+    j = pl.program_id(2)
+    b = bh // n_kv
+    blk = split * bps + j                      # UNclamped global kv block
+    length = lengths_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Blocks entirely past the filled prefix: compute skipped here, DMA
+    # skipped by the clamped index maps (they re-name the last valid block,
+    # which the pipeline recognizes as already resident).
+    @pl.when(blk * block_k < length)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)                   # [g, hd]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)          # [bk, hd]
+        if quant:
+            k = k * ks_ref[0, :, 0, :]                     # dequant in regs
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                          # [g, bk]
+        col = blk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        mask = col < length                                # [1, bk]
+        if with_bitmap:
+            mask = jnp.logical_and(mask, bm_ref[:] != 0)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                              # [g, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # Explicit zero at masked columns: a bitmap-empty block leaves
+        # m_new at -inf and exp(s - m_new) == 1 everywhere without it.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)       # [g, bk]
+        alpha = jnp.exp(m_prev - m_new)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quant:
+            v = v * vs_ref[0, :, 0, :]
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        # UNNORMALIZED partials: the split-K combine outside the kernel
+        # does the single LSE-weighted normalization.
+        o_ref[0, 0] = acc_ref[:]
+        mo_ref[0, 0] = m_ref[:]
+        lo_ref[0, 0] = l_ref[:]
+
+
+def flash_decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    lengths,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    bitmap: Optional[jax.Array] = None,
+    block_k: Optional[int] = None,
+    n_splits: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused flash-decode attention: q [B, H, hd] (one decode step) against
+    the cache k/v [B, S, Hkv, hd] → [B, H, hd].
+
+    ``lengths`` (scalar or [B] int32, REQUIRED): rows < length are
+    attendable; blocks past it are skipped (compute AND traffic), so the
+    step costs O(pos). ``k_scale``/``v_scale`` [B, S, Hkv, 1] switch the
+    cache operands to int8-KV mode (serving._kv_quant layout). ``bitmap``
+    [B, S] bool refines the length mask to exactly the valid rows (the
+    ContinuousBatcher's slot-window validity map); its set bits must lie
+    below ``lengths``. Raises ValueError when ``decode_plan`` has no legal
+    blocking for S — callers that want silent degradation check the plan
+    first and fall back to ``dense_decode_reference``."""
+    b, n_heads, hd = q.shape
+    if k.shape[0] != b or k.shape[3] != hd or v.shape != k.shape:
+        raise ValueError(f"cache shape {k.shape}/{v.shape} does not match "
+                         f"q {q.shape}")
+    s, n_kv = k.shape[1], k.shape[2]
+    if n_heads % n_kv:
+        raise ValueError(
+            f"GQA needs n_heads ({n_heads}) divisible by kv heads ({n_kv})")
+    g = n_heads // n_kv
+    plan = decode_plan(s, block_k, n_splits)
+    if plan is None:
+        raise ValueError(f"no legal decode blocking for S={s} "
+                         f"(block_k={block_k}, n_splits={n_splits})")
+    block_k, n_splits = plan
+    bps = s // block_k // n_splits
+    quant = k_scale is not None
+    if quant and v_scale is None:
+        raise ValueError("int8-KV mode needs both k_scale and v_scale")
+    from . import pallas_interpret
+    interpret = pallas_interpret(interpret)
+
+    lengths = jnp.asarray(lengths, jnp.int32)
+    if lengths.ndim == 0:
+        lengths = jnp.full((b,), lengths, jnp.int32)
+    # [B, H, hd] with H = Hkv*g laid out group-major (matches _repeat_kv's
+    # jnp.repeat ordering) → fold (B, Hkv) into the grid axis.
+    q3 = q.reshape(b * n_kv, g, hd)
+
+    def kv_map(bh, split, j, lens):
+        bb = bh // n_kv
+        blk = split * bps + j
+        last = jnp.maximum(
+            jax.lax.div(lens[bb] + block_k - 1, block_k) - 1, 0)
+        return (bb, jnp.minimum(blk, last), bh % n_kv, 0)
+
+    def bm_map(bh, split, j, lens):
+        bb = bh // n_kv
+        blk = split * bps + j
+        last = jnp.maximum(
+            jax.lax.div(lens[bb] + block_k - 1, block_k) - 1, 0)
+        return (bb, jnp.minimum(blk, last))
+
+    kv_spec = pl.BlockSpec((1, block_k, 1, hd), kv_map)
+    in_specs = [
+        pl.BlockSpec((1, g, hd), lambda bh, split, j, lens: (bh, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    inputs = [q3, k, v]
+    if quant:
+        sc_spec = pl.BlockSpec((1, block_k, 1, 1), kv_map)
+        in_specs += [sc_spec, sc_spec]
+        inputs += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+    if bitmap is not None:
+        in_specs.append(pl.BlockSpec((1, block_k), bm_map))
+        inputs.append(bitmap.astype(jnp.int8))
+
+    part_spec = lambda lanes: pl.BlockSpec(                      # noqa: E731
+        (1, 1, g, lanes), lambda bh, split, j, lens: (bh, split, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * n_kv, n_splits, bps),
+        in_specs=in_specs,
+        out_specs=[part_spec(hd), part_spec(_LANES), part_spec(_LANES)],
+        scratch_shapes=[
+            pltpu.VMEM((g, hd), jnp.float32),     # acc
+            pltpu.VMEM((g, _LANES), jnp.float32),  # m
+            pltpu.VMEM((g, _LANES), jnp.float32),  # l
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, scale=1.0 / math.sqrt(hd), block_k=block_k,
+        n_kv=n_kv, bps=bps, quant=quant, with_bitmap=bitmap is not None)
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n_kv, n_splits, g, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b * n_kv, n_splits, g, _LANES),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((b * n_kv, n_splits, g, _LANES),
+                                 jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(lengths, *inputs)
+
+    # Split-K combine: standard LSE merge of the per-split partials. An
+    # all-masked split contributes (acc=0, m=-inf, l=0) and drops out; a
+    # fully-masked ROW (length 0 / empty bitmap) yields zeros, unlike the
+    # dense reference's uniform softmax — both are garbage by contract.
+    m1, l1 = m[..., :1], l[..., :1]                  # [BH, ns, g, 1]
+    m_tot = jnp.max(m1, axis=1, keepdims=True)
+    w = jnp.exp(m1 - m_tot)
+    l_tot = jnp.sum(l1 * w, axis=1)                  # [BH, g, 1]
+    out = jnp.sum(acc * w, axis=1) / jnp.maximum(l_tot, 1e-20)
+    return out.reshape(b, n_heads, hd).astype(q.dtype)

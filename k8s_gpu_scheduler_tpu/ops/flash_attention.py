@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._pallas import CompilerParams as _CompilerParams
 from .attention import _repeat_kv
 
 _NEG_INF = -1e30
@@ -145,7 +146,7 @@ def _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret,
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # l
             pltpu.VMEM((block_q, d), jnp.float32),       # acc
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -268,7 +269,7 @@ def _flash_backward(q3, k3, v3, o3, lse, do3, causal, block_q, block_k,
     bh, t, d = q3.shape
     scale = 1.0 / math.sqrt(d)
     common = dict(
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -345,9 +346,11 @@ def _resolve(t, block_q, block_k, interpret):
     if t % block_q or t % block_k:
         raise ValueError(f"seq len {t} not divisible by blocks "
                          f"({block_q}/{block_k})")
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
-    return block_q, block_k, interpret
+    # Shared env/flag-driven toggle (ops.pallas_interpret — lazy import,
+    # the package imports this module): interpret off-TPU or when
+    # TPU_SCHED_PALLAS_INTERPRET forces it, so tier-1 runs the kernels.
+    from . import pallas_interpret
+    return block_q, block_k, pallas_interpret(interpret)
 
 
 def flash_attention(

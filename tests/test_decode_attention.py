@@ -1,0 +1,302 @@
+"""Fused Pallas flash-decode attention (ops/decode_attention.py) vs the
+grouped dense reference — the serving engine's decode hot path.
+
+Everything runs in interpret mode on CPU (the shared ops.pallas_interpret
+toggle); the same kernel compiles on TPU, where bench.py's
+`--leg decode_attention` microbench measures it. The dense grouped-einsum
+reference is itself pinned against an explicit `_repeat_kv` formulation
+(the pre-fused serving path), so the kernel and its reference cannot drift
+wrong together.
+"""
+import dataclasses
+import json
+import math
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_scheduler_tpu.ops import (
+    decode_plan, dense_decode_reference, flash_decode_attention,
+    pallas_interpret,
+)
+from k8s_gpu_scheduler_tpu.ops.attention import _repeat_kv
+
+TOL = {jnp.float32: 3e-6, jnp.bfloat16: 4e-2}
+
+
+def qkv(B=2, H=8, Hkv=4, hd=32, S=64, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (
+        jax.random.normal(ks[0], (B, H, hd), dtype),
+        jax.random.normal(ks[1], (B, S, Hkv, hd), dtype),
+        jax.random.normal(ks[2], (B, S, Hkv, hd), dtype),
+    )
+
+
+def repeat_reference(q, k, v, lengths, bitmap=None):
+    """The pre-fused dense formulation: explicit `_repeat_kv`
+    materialization, f32 masked softmax — the semantics both new paths
+    must reproduce."""
+    B, H, hd = q.shape
+    S = k.shape[1]
+    kr, vr = _repeat_kv(k, H), _repeat_kv(v, H)
+    scores = jnp.einsum("bhd,bkhd->bhk", q, kr).astype(jnp.float32)
+    scores = scores / math.sqrt(hd)
+    mask = jnp.arange(S)[None, :] < jnp.asarray(lengths)[:, None]
+    if bitmap is not None:
+        mask = mask & bitmap
+    scores = jnp.where(mask[:, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhk,bkhd->bhd", probs, vr)
+
+
+def maxdiff(a, b):
+    return float(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)).max())
+
+
+class TestDecodePlan:
+    def test_plan_picks_divisible_blocks(self):
+        assert decode_plan(8192) == (256, 8)
+        assert decode_plan(512) == (256, 1)
+        assert decode_plan(32) == (32, 1)
+        assert decode_plan(100) is None              # no pow2 block divides
+        assert decode_plan(64, block_k=48) is None
+        assert decode_plan(64, block_k=8, n_splits=3) is None
+        assert decode_plan(64, block_k=8, n_splits=4) == (8, 4)
+
+    def test_unsupported_shapes_raise(self):
+        q, k, v = qkv(S=100)
+        with pytest.raises(ValueError):
+            flash_decode_attention(q, k, v, 50, interpret=True)
+        q, k, v = qkv(H=6, Hkv=4)
+        with pytest.raises(ValueError):
+            flash_decode_attention(q, k, v, 50, interpret=True)
+
+
+class TestDenseReference:
+    """The grouped-einsum rewrite must equal the old repeat-kv math —
+    this is the satellite fix (no H/Hkv-times cache copy per token) and
+    the anchor for every fused-vs-dense comparison below."""
+
+    @pytest.mark.parametrize("hkv", [8, 2, 1])
+    def test_grouped_matches_repeat(self, hkv):
+        q, k, v = qkv(Hkv=hkv)
+        lengths = jnp.array([17, 63])
+        ref = repeat_reference(q, k, v, lengths)
+        out = dense_decode_reference(q, k, v, lengths=lengths)
+        assert maxdiff(out, ref) < 1e-6
+
+    def test_grouped_int8_matches_dequantized_repeat(self):
+        from k8s_gpu_scheduler_tpu.models.serving import _kv_quant
+
+        q, k, v = qkv()
+        kq, ks = _kv_quant(k)
+        vq, vs = _kv_quant(v)
+        lengths = jnp.array([30, 64])
+        ref = repeat_reference(q, kq.astype(q.dtype) * ks,
+                               vq.astype(q.dtype) * vs, lengths)
+        out = dense_decode_reference(q, kq, vq, lengths=lengths,
+                                     k_scale=ks, v_scale=vs)
+        # Factored scales (on scores/probs) vs elementwise dequant: same
+        # math, different rounding points.
+        assert maxdiff(out, ref) < 1e-4
+
+
+class TestFusedParity:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("hkv", [8, 2, 1])           # Hkv = H, H/4, H/8
+    def test_gqa_and_dtypes(self, dtype, hkv):
+        q, k, v = qkv(Hkv=hkv, dtype=dtype)
+        lengths = jnp.array([17, 63])
+        ref = dense_decode_reference(q, k, v, lengths=lengths)
+        out = flash_decode_attention(q, k, v, lengths, block_k=16,
+                                     interpret=True)
+        assert out.dtype == q.dtype
+        assert maxdiff(out, ref) < TOL[dtype]
+
+    def test_ragged_fill_lengths(self):
+        """pos = 0, 1, block-1, block, max_seq-1 with block_k=16: every
+        block-boundary case of the traced length mask (lengths = pos+1)."""
+        B = 5
+        q, k, v = qkv(B=B, S=64)
+        lengths = jnp.array([1, 2, 16, 17, 64])      # pos + 1
+        ref = dense_decode_reference(q, k, v, lengths=lengths)
+        out = flash_decode_attention(q, k, v, lengths, block_k=16,
+                                     interpret=True)
+        assert maxdiff(out, ref) < 1e-5
+
+    def test_scalar_length_broadcasts(self):
+        q, k, v = qkv()
+        ref = dense_decode_reference(q, k, v, lengths=jnp.array([23, 23]))
+        out = flash_decode_attention(q, k, v, 23, block_k=16, interpret=True)
+        assert maxdiff(out, ref) < 1e-5
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_int8_kv(self, dtype):
+        from k8s_gpu_scheduler_tpu.models.serving import _kv_quant
+
+        q, k, v = qkv(dtype=dtype)
+        kq, ks = _kv_quant(k)
+        vq, vs = _kv_quant(v)
+        lengths = jnp.array([9, 64])
+        ref = dense_decode_reference(q, kq, vq, lengths=lengths,
+                                     k_scale=ks, v_scale=vs)
+        out = flash_decode_attention(q, kq, vq, lengths, k_scale=ks,
+                                     v_scale=vs, block_k=16, interpret=True)
+        assert maxdiff(out, ref) < TOL[dtype]
+
+    def test_split_k_combine(self):
+        """Split-K partials merged by the LSE combine must equal both the
+        single-split sweep and the dense reference — including splits that
+        are entirely past the filled prefix (all-masked partials)."""
+        q, k, v = qkv(S=128)
+        lengths = jnp.array([5, 100])                # split 4 dead for row 0
+        ref = dense_decode_reference(q, k, v, lengths=lengths)
+        one = flash_decode_attention(q, k, v, lengths, block_k=16,
+                                     n_splits=1, interpret=True)
+        four = flash_decode_attention(q, k, v, lengths, block_k=16,
+                                      n_splits=4, interpret=True)
+        assert maxdiff(one, ref) < 1e-5
+        assert maxdiff(four, ref) < 1e-5
+        assert maxdiff(four, one) < 1e-5
+
+    def test_bitmap_masking(self):
+        """The ContinuousBatcher's validity-bitmap mode: set bits ⊆
+        lengths window, holes inside it."""
+        q, k, v = qkv()
+        lengths = jnp.array([20, 64])
+        key = jax.random.PRNGKey(3)
+        bm = jax.random.bernoulli(key, 0.6, (2, 64))
+        bm = bm & (jnp.arange(64)[None, :] < lengths[:, None])
+        bm = bm.at[:, 0].set(True)                   # keep rows non-empty
+        ref = dense_decode_reference(q, k, v, bitmap=bm)
+        out = flash_decode_attention(q, k, v, lengths, bitmap=bm,
+                                     block_k=16, interpret=True)
+        assert maxdiff(out, ref) < 1e-5
+
+    def test_runs_under_jit_and_scan(self):
+        q, k, v = qkv()
+        lengths = jnp.array([17, 63])
+        ref = dense_decode_reference(q, k, v, lengths=lengths)
+
+        def step(c, _):
+            return c, flash_decode_attention(q, k, v, lengths, block_k=16)
+
+        _, outs = jax.jit(
+            lambda: jax.lax.scan(step, 0, None, length=2))()
+        assert maxdiff(outs[1], ref) < 1e-5
+
+
+class TestServingIntegration:
+    """The config flag end-to-end: fused decode must be token-identical to
+    the dense path through generate() and the ContinuousBatcher (f32
+    params so greedy argmax has no near-tie noise)."""
+
+    def _cfg(self, **kw):
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig
+
+        return dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                                   **kw)
+
+    def test_cached_attention_fused_matches_dense(self):
+        from k8s_gpu_scheduler_tpu.models.serving import cached_attention
+
+        q, k, v = qkv(Hkv=2)
+        q4 = q[:, None]                              # [B, 1, H, hd]
+        pos = jnp.int32(21)
+        ref = cached_attention(q4, k, v, pos)
+        out = cached_attention(q4, k, v, pos, impl="fused", interpret=True)
+        assert maxdiff(out, ref) < 1e-5
+
+    def test_cached_attention_prefill_falls_back(self):
+        """t > 1 (prefill / speculative verify) must route dense — and
+        keep the causal window inside the new tokens."""
+        from k8s_gpu_scheduler_tpu.models.serving import cached_attention
+
+        B, t, H, hd, S = 2, 4, 4, 16, 32
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = jax.random.normal(ks[0], (B, t, H, hd))
+        k = jax.random.normal(ks[1], (B, S, H, hd))
+        v = jax.random.normal(ks[2], (B, S, H, hd))
+        ref = cached_attention(q, k, v, jnp.int32(3))
+        out = cached_attention(q, k, v, jnp.int32(3), impl="fused",
+                               interpret=True)
+        assert maxdiff(out, ref) < 1e-6
+
+    def test_generate_token_identity(self):
+        from k8s_gpu_scheduler_tpu.models import generate, init_params
+
+        cfg = self._cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0,
+                                    cfg.vocab)
+        ref = generate(params, prompt, cfg, max_new=6, max_len=32)
+        out = generate(params, prompt,
+                       dataclasses.replace(cfg, decode_attn="fused"),
+                       max_new=6, max_len=32)
+        assert (ref == out).all()
+
+    @pytest.mark.parametrize("kvd", [None, "int8"])
+    def test_batcher_fused_matches_dense_engine(self, kvd):
+        """Same engine geometry, dense vs fused decode_attn: the emitted
+        streams must be identical (bitmap masking + cursor length bound
+        reproduce the dense bitmap semantics exactly for active slots)."""
+        from k8s_gpu_scheduler_tpu.models import init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = self._cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        prompts = [list(rng.integers(0, cfg.vocab, n)) for n in (3, 5, 4)]
+        outs = {}
+        for impl in ("dense", "fused"):
+            eng = ContinuousBatcher(
+                params, dataclasses.replace(cfg, decode_attn=impl),
+                n_slots=2, max_len=32, chunk=4, prefill_bucket=8,
+                kv_dtype=kvd)
+            ids = [eng.submit(p, max_new=5) for p in prompts]
+            done = eng.run()
+            outs[impl] = [done[i] for i in ids]
+        assert outs["fused"] == outs["dense"]
+
+
+class TestInterpretToggle:
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("TPU_SCHED_PALLAS_INTERPRET", "1")
+        assert pallas_interpret() is True
+        monkeypatch.setenv("TPU_SCHED_PALLAS_INTERPRET", "0")
+        assert pallas_interpret() is False
+        monkeypatch.delenv("TPU_SCHED_PALLAS_INTERPRET")
+        # CPU backend in tier-1 → interpret by default.
+        assert pallas_interpret() is True
+        assert pallas_interpret(False) is False
+
+
+class TestBenchLeg:
+    def test_decode_attention_microbench_smoke(self):
+        """`bench.py --leg decode_attention --smoke` must emit ONE JSON
+        line with dense-vs-fused tokens/s for both cache dtypes — the
+        contract future BENCH_*.json capture rides on."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "bench.py", "--leg", "decode_attention",
+             "--smoke"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            capture_output=True, text=True, timeout=600, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+        assert len(lines) == 1, out.stdout
+        rec = json.loads(lines[0])
+        assert rec["metric"] == "decode_attention_microbench"
+        extra = rec["extra"]
+        for key in ("decattn_dense_bf16_tok_s", "decattn_fused_bf16_tok_s",
+                    "decattn_dense_int8kv_tok_s",
+                    "decattn_fused_int8kv_tok_s",
+                    "decattn_bytes_per_step_bf16",
+                    "decattn_bytes_per_step_int8kv"):
+            assert key in extra and extra[key] > 0, (key, extra)
