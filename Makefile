@@ -5,13 +5,20 @@
 PY ?= python
 IMG_TAG ?= 0.1.0
 
-.PHONY: all native test e2e bench demo images install uninstall clean
+.PHONY: all native lint test e2e bench demo images install uninstall clean
 
-all: native test
+all: native lint test
 
 native:
 	$(MAKE) -C native/kvstore
 	$(MAKE) -C native/tpuprobe
+
+# graftcheck fast passes (AST lint + Pallas VMEM budgeter — no tracing;
+# the same gate tier-1 runs via tests/test_graftcheck_clean.py). The full
+# four-pass analyzer (jaxpr audit + recompile/donation guard) is
+# `$(PY) -m k8s_gpu_scheduler_tpu.analysis` with no flags.
+lint:
+	$(PY) -m k8s_gpu_scheduler_tpu.analysis --fast
 
 test: native
 	$(PY) -m pytest tests/

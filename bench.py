@@ -738,6 +738,52 @@ def bench_decode_attention(smoke=False):
     }
 
 
+def bench_analysis(smoke=False):
+    """graftcheck latency leg: wall time of the analyzer over the whole
+    repo, recorded in BENCH_r*.json so lint latency is a tracked metric —
+    a pass that quietly grows from 2 s to 2 minutes is a CI tax nobody
+    budgeted. ``--smoke`` (and the headline value either way) times the
+    FAST passes (AST lint + VMEM — what tier-1 runs every collection);
+    the full four-pass wall time rides in ``extra`` unless smoking."""
+    if not smoke:
+        # Mirror the CLI's env (analysis/__main__.py): the traced passes
+        # want hermetic CPU and a multi-device mesh for the pipeline entry
+        # point. setdefault is a no-op when jax is already initialized
+        # (full-line callers run smoke=True, so only the standalone leg
+        # reaches here before the first jax import).
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import k8s_gpu_scheduler_tpu
+    from k8s_gpu_scheduler_tpu.analysis import (
+        run_fast_passes, run_traced_passes,
+    )
+
+    pkg = os.path.dirname(os.path.abspath(k8s_gpu_scheduler_tpu.__file__))
+    t0 = time.perf_counter()
+    fast = run_fast_passes([pkg])
+    fast_s = time.perf_counter() - t0
+    extra = {
+        "analysis_fast_s": round(fast_s, 3),
+        "analysis_findings": len(fast.findings),
+        **{f"analysis_{k}_s": round(v, 3)
+           for k, v in fast.pass_seconds.items()},
+    }
+    if not smoke:
+        t0 = time.perf_counter()
+        traced = run_traced_passes([pkg])
+        extra["analysis_traced_s"] = round(time.perf_counter() - t0, 3)
+        extra["analysis_findings"] += len(traced.findings)
+        extra.update({f"analysis_{k}_s": round(v, 3)
+                      for k, v in traced.pass_seconds.items()})
+    return {
+        "metric": "analysis_lint_wall",
+        "value": round(fast_s, 3),
+        "unit": "s",
+        "extra": extra,
+    }
+
+
 def _random_int8_llama_params(cfg, seed: int = 0):
     """Random FULL-DEPTH int8 params built directly on device in quantized
     form ({"q","s"} leaves, ops/quant.py layout): a real 8B never exists in
@@ -834,8 +880,11 @@ def main(argv=None):
             print(json.dumps(bench_decode_attention(
                 smoke="--smoke" in args)))
             return
+        if leg == "analysis":
+            print(json.dumps(bench_analysis(smoke="--smoke" in args)))
+            return
         raise SystemExit(f"unknown bench leg: {leg!r} "
-                         f"(available: decode_attention)")
+                         f"(available: decode_attention, analysis)")
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
     # the bench measures the scheduler as deployed.
     sys.setswitchinterval(0.001)
@@ -884,6 +933,13 @@ def main(argv=None):
         serve = bench_serving()
     except Exception as e:  # noqa: BLE001
         serve = {"serve_error": str(e)[:200]}
+    try:
+        # Fast passes only in the headline line (the dedicated
+        # `--leg analysis` records the traced passes too): lint latency is
+        # tracked so it can't quietly become a CI tax.
+        analysis = bench_analysis(smoke=True)["extra"]
+    except Exception as e:  # noqa: BLE001
+        analysis = {"analysis_error": str(e)[:200]}
     p50 = churn["p50_ms"] or 1e-6
     print(json.dumps({
         "metric": "p50_schedule_latency_64pod_churn",
@@ -891,7 +947,7 @@ def main(argv=None):
         "unit": "ms",
         "vs_baseline": round(BASELINE_P50_MS / p50, 2),
         "extra": {**churn, **churn_rest, **churn_256, **mixed, **train,
-                  **serve},
+                  **serve, **analysis},
     }))
 
 

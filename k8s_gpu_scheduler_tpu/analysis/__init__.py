@@ -1,0 +1,181 @@
+"""graftcheck — static analysis for the jax_graft serving/training stack.
+
+Four coordinated passes over the repo (``python -m
+k8s_gpu_scheduler_tpu.analysis``; importable APIs below):
+
+1. **AST lint** (``astlint``): jit-hostile patterns (tracer casts, host
+   time/numpy/syncs inside traced functions, bare except) and the
+   scheduler lock-lint (attributes a ``threading.Lock`` guards, accessed
+   outside it).
+2. **VMEM budgeter** (``vmem``): static working-set estimates for the
+   Pallas kernels against the ~16 MiB/core budget, plus block
+   divisibility for every LlamaConfig preset.
+3. **jaxpr audit** (``jaxpr_audit`` + ``entrypoints``): traces the jitted
+   entry points and flags captured weight constants, f32 upcasts in bf16
+   paths, dead outputs, and host transfers in hot loops.
+4. **Recompile guard** (``recompile``): jit cache-miss accounting + the
+   donation contract (buffers actually consumed), with a pytest fixture
+   (tests/conftest.py ``recompile_guard``) asserting steady-state decode
+   never retraces.
+
+Suppression: ``# graftcheck: ignore[rule]`` on the offending line, with a
+rationale in the surrounding comment (policy in README).
+
+The AST + VMEM passes are import-light and fast — ``make lint`` and the
+tier-1 gate (tests/test_graftcheck_clean.py) run only those; the traced
+passes add a few seconds and run in the full CLI and their own tests.
+"""
+from .findings import ALL_RULES, Finding, Report, parse_suppressions
+from .astlint import lint_source, run_astlint
+from .vmem import (
+    VMEM_BYTES_PER_CORE, audit_vmem, decode_attention_footprint,
+    flash_attention_footprint,
+)
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "Report",
+    "parse_suppressions",
+    "lint_source",
+    "run_astlint",
+    "VMEM_BYTES_PER_CORE",
+    "audit_vmem",
+    "decode_attention_footprint",
+    "flash_attention_footprint",
+    "run_fast_passes",
+    "run_traced_passes",
+]
+
+
+def run_fast_passes(paths=None) -> Report:
+    """AST lint + VMEM budgeter — no tracing, suitable for collection-time
+    gating. ``paths`` defaults to the installed package directory. Files
+    defining ``GRAFTCHECK_VMEM_AUDIT`` (a list of ``(name, footprint)``
+    pairs) get their declared kernel footprints budget-checked too."""
+    import os
+    import time
+
+    report = Report()
+    if paths is None:
+        paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    t0 = time.perf_counter()
+    report.extend(run_astlint(paths))
+    report.pass_seconds["astlint"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    report.extend(audit_vmem())
+    for src, _attr, entries in _discover_hooks(
+            paths, ("GRAFTCHECK_VMEM_AUDIT",)):
+        for entry in _safe_entries(report, src, "GRAFTCHECK_VMEM_AUDIT",
+                                   entries, arity=2):
+            name, fp = entry
+            report.extend(fp.check(anchor=src))
+    report.pass_seconds["vmem"] = time.perf_counter() - t0
+    return report
+
+
+def _safe_entries(report: Report, src: str, attr: str, entries,
+                  arity: int):
+    """Yield well-formed hook entries; malformed ones (wrong arity, not a
+    tuple) and import failures become findings instead of crashing the
+    run — a broken hook must surface, not take the lint down with it."""
+    if isinstance(entries, Exception):
+        report.extend([Finding("hook-error", src, 0,
+                               f"{attr}: {type(entries).__name__}: "
+                               f"{entries}")])
+        return
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, (tuple, list)) or len(entry) != arity:
+            report.extend([Finding(
+                "hook-error", src, 0,
+                f"{attr}[{i}]: expected a {arity}-tuple, got "
+                f"{type(entry).__name__}")])
+            continue
+        yield entry
+
+
+def run_traced_passes(paths=None) -> Report:
+    """jaxpr audit + recompile/donation guard over the entry-point
+    registry, plus any ``GRAFTCHECK_JAXPR_AUDIT`` /
+    ``GRAFTCHECK_RECOMPILE_AUDIT`` hooks found in ``paths`` (how a seeded
+    bad-fixture file, if it lands in the tree, gets caught)."""
+    import time
+
+    from . import entrypoints as eps
+    from .jaxpr_audit import audit_callable
+    from .recompile import audit_steady_state
+
+    report = Report()
+    hooks = list(_discover_hooks(
+        paths, ("GRAFTCHECK_JAXPR_AUDIT", "GRAFTCHECK_RECOMPILE_AUDIT")))
+
+    t0 = time.perf_counter()
+    for name, fn, args in eps.jaxpr_entrypoints():
+        report.extend(audit_callable(fn, args, name))
+    for src, attr, entries in hooks:
+        if attr != "GRAFTCHECK_JAXPR_AUDIT":
+            continue
+        for entry in _safe_entries(report, src, attr, entries, arity=3):
+            name, fn, args = entry
+            report.extend(audit_callable(fn, args, name))
+    report.pass_seconds["jaxpr"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for name, build in eps.recompile_scenarios():
+        report.extend(audit_steady_state(build, name))
+    for src, attr, entries in hooks:
+        if attr != "GRAFTCHECK_RECOMPILE_AUDIT":
+            continue
+        for entry in _safe_entries(report, src, attr, entries, arity=2):
+            name, build = entry
+            report.extend(audit_steady_state(build, name))
+    report.extend(eps.donation_audit())
+    report.pass_seconds["recompile"] = time.perf_counter() - t0
+    return report
+
+
+def _discover_hooks(paths, attrs: tuple):
+    """Find modules under ``paths`` whose top level assigns any of the
+    hook ``attrs``, import each such module ONCE, and yield
+    ``(path, attr, entries)`` per attr it defines — ``entries`` is the
+    registered list, or the Exception if the import failed (a broken hook
+    must surface as a finding, not vanish). One tree walk and one
+    exec_module per file regardless of how many hook attrs it defines."""
+    import ast
+    import importlib.util
+    import os
+
+    from .astlint import iter_python_files
+
+    if paths is None:
+        paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    for path in iter_python_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            present = [a for a in attrs if a in src]
+            if not present:
+                continue
+            tree = ast.parse(src)
+
+            def targets_of(n):
+                if isinstance(n, ast.Assign):
+                    return n.targets
+                if isinstance(n, ast.AnnAssign):   # GRAFTCHECK_X: list = …
+                    return [n.target]
+                return []
+
+            assigned = [a for a in present if any(
+                getattr(t, "id", None) == a
+                for n in tree.body for t in targets_of(n))]
+            if not assigned:
+                continue
+            spec = importlib.util.spec_from_file_location(
+                f"_graftcheck_hook_{abs(hash(path))}", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception as e:  # noqa: BLE001 — a broken hook is a finding
+            yield path, attrs[0], e
+            continue
+        for attr in assigned:
+            yield path, attr, list(getattr(mod, attr, []))

@@ -1,0 +1,71 @@
+"""graftcheck CLI — ``python -m k8s_gpu_scheduler_tpu.analysis [paths...]``.
+
+Default: all four passes (AST lint, VMEM budgeter, jaxpr audit, recompile
+guard) over the package tree plus any extra ``paths``. Exit code 0 iff no
+error-severity findings; findings print as ``file:line: [rule] message``.
+
+``--fast`` runs only the AST + VMEM passes (no jax tracing) — what
+``make lint`` and the tier-1 gate use. ``--json`` emits a machine-
+readable summary (the bench leg consumes it).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m k8s_gpu_scheduler_tpu.analysis",
+        description="graftcheck static analysis")
+    parser.add_argument("paths", nargs="*",
+                        help="extra files/dirs to analyze (the package "
+                             "tree is always included)")
+    parser.add_argument("--fast", action="store_true",
+                        help="AST lint + VMEM budgeter only (no tracing)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit a JSON summary line")
+    parser.add_argument("--warnings-as-errors", action="store_true")
+    args = parser.parse_args(argv)
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [pkg_root] + list(args.paths)
+
+    if not args.fast:
+        # The traced passes initialize jax: keep tier-1's hermetic-CPU
+        # convention and give the pipeline entry point a multi-device mesh
+        # BEFORE the first jax import.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+    from . import run_fast_passes, run_traced_passes
+
+    report = run_fast_passes(paths)
+    if not args.fast:
+        traced = run_traced_passes(paths)
+        report.findings.extend(traced.findings)
+        report.pass_seconds.update(traced.pass_seconds)
+
+    failing = report.findings if args.warnings_as_errors else report.errors
+    if args.json:
+        print(json.dumps({
+            "findings": len(report.findings),
+            "errors": len(report.errors),
+            "pass_seconds": {k: round(v, 3)
+                             for k, v in report.pass_seconds.items()},
+            "rules": sorted({f.rule for f in report.findings}),
+        }))
+    if report.findings:
+        print(report.render(header="graftcheck findings:"), file=sys.stderr)
+    else:
+        timing = ", ".join(f"{k} {v * 1000:.0f} ms"
+                           for k, v in report.pass_seconds.items())
+        print(f"graftcheck: clean ({timing})", file=sys.stderr)
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,450 @@
+"""AST lint — jit-hostility and lock-discipline rules, no jax import.
+
+Two families of purely syntactic rules over the package source:
+
+**Traced-function rules.** A "traced function" is one whose body runs
+under `jax.jit`/`shard_map`/`lax.scan`-style tracing, where host-side
+operations are either trace-time constants (silent staleness) or forced
+device syncs (silent serialization). Detection is per-module and
+syntactic: functions passed to / decorated with the jax wrappers, plus
+their nested defs, plus (to a same-module fixpoint) functions they call.
+Inside those bodies:
+
+- ``tracer-cast``: ``int()``/``float()``/``bool()`` on a non-literal —
+  forces the tracer concrete (ConcretizationTypeError at best, a silent
+  host sync under eager fallback at worst).
+- ``host-time-in-trace``: ``time.time()`` and friends — evaluated ONCE at
+  trace time; the compiled program replays a constant timestamp forever.
+- ``numpy-in-trace``: ``np.*()`` calls — host math on tracer values
+  either errors or constant-folds at trace time.
+- ``host-sync-in-trace``: ``.item()``, ``block_until_ready``,
+  ``device_get``/``device_put`` inside a traced body.
+
+**Repo-wide rules.**
+
+- ``host-sync``: ``block_until_ready``/``device_get``/``device_put``
+  anywhere in package host code. Every sanctioned sync point (the serving
+  entrypoint loops in models/, the batcher's one batched readback) carries
+  a ``# graftcheck: ignore[host-sync]`` with its rationale — the rule
+  exists so a NEW sync cannot slip into a hot loop unreviewed.
+- ``bare-except``: ``except:`` with no exception class.
+- ``lock-guard``: per class, map each ``threading.Lock/RLock/Condition``
+  attribute to the ``self.*`` attributes accessed inside its ``with
+  self._mu:`` blocks (the guarded set), then flag any access of a guarded
+  attribute outside the lock. Conventions honored: ``__init__`` is exempt
+  (construction happens-before publication), methods named ``*_locked``
+  are exempt (documented call-with-lock-held helpers), attributes that
+  are themselves thread-safe primitives (Event/Thread/executors/queues)
+  are never considered guarded, and nested functions are treated as
+  lock-NOT-held (closures usually run on other threads).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .findings import Finding, apply_suppressions, parse_suppressions
+
+# jax tracing wrappers: a function argument of any of these is traced.
+_TRACE_WRAPPERS = {
+    "jit", "pmap", "shard_map", "checkpoint", "remat", "custom_vjp",
+    "custom_jvp", "grad", "value_and_grad", "vjp", "jvp", "linearize",
+    "vmap", "scan", "while_loop", "cond", "fori_loop", "switch",
+    "pallas_call", "make_jaxpr",
+}
+_LOCK_TYPES = {"Lock", "RLock", "Condition"}
+# NOTE: threading.Thread is deliberately NOT here — the Thread object is
+# thread-safe but rebinding a self._thread REFERENCE under a worker
+# spawn/exit protocol is exactly the state a lock guards.
+_THREADSAFE_TYPES = {
+    "Event", "ThreadPoolExecutor", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Queue", "SimpleQueue", "local",
+}
+_HOST_SYNC_ATTRS = {"block_until_ready", "device_get", "device_put"}
+# Receiver methods that mutate the receiver — a call under the lock marks
+# the receiver attribute as lock-owned state.
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "popitem", "clear", "update",
+    "add", "discard", "remove", "setdefault", "appendleft", "popleft",
+    "heappush", "heappop",
+}
+_HOST_TIME_ATTRS = {"time", "perf_counter", "monotonic", "process_time",
+                    "time_ns", "perf_counter_ns", "monotonic_ns"}
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _walk_shallow(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body without descending into nested function/lambda
+    bodies (those are linted as their own traced units)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class _Scopes:
+    """Name -> FunctionDef resolution through lexically enclosing scopes.
+    Class bodies are not scope boundaries here: methods register in the
+    enclosing module/function table (harmless for this lint's purposes)."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        # scope node id -> {name: def node}; parent chain for lookup.
+        self.tables: Dict[int, Dict[str, ast.AST]] = {}
+        self.parents: Dict[int, Optional[ast.AST]] = {}
+        self._build(tree, None)
+
+    def _build(self, scope: ast.AST, parent: Optional[ast.AST]) -> None:
+        table: Dict[str, ast.AST] = {}
+        self.tables[id(scope)] = table
+        self.parents[id(scope)] = parent
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                table[n.name] = n
+                self._build(n, scope)
+            elif isinstance(n, ast.Lambda):
+                self._build(n, scope)
+            else:
+                stack.extend(ast.iter_child_nodes(n))
+
+    def resolve(self, scope: ast.AST, name: str) -> Optional[ast.AST]:
+        cur: Optional[ast.AST] = scope
+        while cur is not None:
+            table = self.tables.get(id(cur), {})
+            if name in table:
+                return table[name]
+            cur = self.parents.get(id(cur))
+        return None
+
+
+def _numpy_aliases(tree: ast.Module) -> Set[str]:
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    aliases.add(a.asname or "numpy")
+    return aliases
+
+
+def _fn_candidates_of_call(call: ast.Call) -> List[ast.AST]:
+    """AST nodes that are plausibly the function being traced in a wrapper
+    call: every positional arg that is a lambda, a name, or a
+    partial(...) whose first arg is one of those."""
+    out: List[ast.AST] = []
+    for arg in call.args:
+        if isinstance(arg, (ast.Lambda, ast.Name)):
+            out.append(arg)
+        elif (isinstance(arg, ast.Call)
+              and _terminal_name(arg.func) == "partial" and arg.args):
+            inner = arg.args[0]
+            if isinstance(inner, (ast.Lambda, ast.Name)):
+                out.append(inner)
+        elif isinstance(arg, (ast.List, ast.Tuple)):   # lax.switch branches
+            out.extend(e for e in arg.elts
+                       if isinstance(e, (ast.Lambda, ast.Name)))
+    return out
+
+
+def _collect_traced(tree: ast.Module, scopes: _Scopes) -> Set[int]:
+    """ids of FunctionDef/Lambda nodes whose bodies are traced."""
+    # Map node-id -> enclosing scope node, for name resolution.
+    enclosing: Dict[int, ast.AST] = {}
+
+    def assign_scopes(node: ast.AST, scope: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            enclosing[id(child)] = scope
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                assign_scopes(child, child)
+            else:
+                assign_scopes(child, scope)
+
+    assign_scopes(tree, tree)
+
+    traced: Set[int] = set()
+    traced_nodes: List[ast.AST] = []
+
+    def mark(node: ast.AST) -> None:
+        if id(node) not in traced:
+            traced.add(id(node))
+            traced_nodes.append(node)
+
+    # Seed: wrapper calls + decorators.
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and _terminal_name(node.func) in _TRACE_WRAPPERS:
+            for cand in _fn_candidates_of_call(node):
+                if isinstance(cand, ast.Lambda):
+                    mark(cand)
+                else:
+                    target = scopes.resolve(
+                        enclosing.get(id(cand), tree), cand.id)
+                    if target is not None:
+                        mark(target)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                name = _terminal_name(dec)
+                if name in _TRACE_WRAPPERS:
+                    mark(node)
+                elif isinstance(dec, ast.Call):
+                    fname = _terminal_name(dec.func)
+                    if fname in _TRACE_WRAPPERS:
+                        mark(node)
+                    elif fname == "partial" and dec.args and _terminal_name(
+                            dec.args[0]) in _TRACE_WRAPPERS:
+                        mark(node)
+
+    # Fixpoint: nested defs of traced fns are traced; same-module functions
+    # CALLED from traced bodies are traced (one-module call graph closure).
+    i = 0
+    while i < len(traced_nodes):
+        fn = traced_nodes[i]
+        i += 1
+        for sub in _walk_shallow(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                mark(sub)
+            elif isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                target = scopes.resolve(
+                    enclosing.get(id(sub), tree), sub.func.id)
+                if target is not None:
+                    mark(target)
+    return traced
+
+
+def _lint_traced_body(path: str, fn: ast.AST, np_aliases: Set[str],
+                      fn_label: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in _walk_shallow(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        if isinstance(node.func, ast.Name) and name in ("int", "float",
+                                                        "bool"):
+            if node.args and not isinstance(node.args[0], ast.Constant):
+                out.append(Finding(
+                    "tracer-cast", path, node.lineno,
+                    f"{name}() on a non-literal inside traced function "
+                    f"{fn_label}: concretizes the tracer (host sync or "
+                    f"trace error)"))
+        elif isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            if (isinstance(base, ast.Name) and base.id == "time"
+                    and name in _HOST_TIME_ATTRS):
+                out.append(Finding(
+                    "host-time-in-trace", path, node.lineno,
+                    f"time.{name}() inside traced function {fn_label}: "
+                    f"evaluated once at trace time, constant thereafter"))
+            elif isinstance(base, ast.Name) and base.id in np_aliases:
+                out.append(Finding(
+                    "numpy-in-trace", path, node.lineno,
+                    f"{base.id}.{name}() inside traced function {fn_label}: "
+                    f"host numpy does not trace; use jnp"))
+            elif name == "item" and not node.args:
+                out.append(Finding(
+                    "host-sync-in-trace", path, node.lineno,
+                    f".item() inside traced function {fn_label}"))
+            elif name in _HOST_SYNC_ATTRS:
+                out.append(Finding(
+                    "host-sync-in-trace", path, node.lineno,
+                    f"{name}() inside traced function {fn_label}"))
+    return out
+
+
+def _lint_module_wide(path: str, tree: ast.Module,
+                      traced: Set[int]) -> List[Finding]:
+    out: List[Finding] = []
+    # Host-sync sites OUTSIDE traced bodies (traced ones already got the
+    # stronger host-sync-in-trace finding).
+    traced_ranges: List[Tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if id(node) in traced and hasattr(node, "lineno"):
+            traced_ranges.append(
+                (node.lineno, getattr(node, "end_lineno", node.lineno)))
+
+    def in_traced(lineno: int) -> bool:
+        return any(a <= lineno <= b for a, b in traced_ranges)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            out.append(Finding(
+                "bare-except", path, node.lineno,
+                "bare 'except:' swallows KeyboardInterrupt/SystemExit; "
+                "catch Exception (or narrower)"))
+        elif isinstance(node, ast.Call):
+            name = _terminal_name(node.func)
+            if name in _HOST_SYNC_ATTRS and not in_traced(node.lineno):
+                out.append(Finding(
+                    "host-sync", path, node.lineno,
+                    f"{name}() is a host<->device sync point; sanctioned "
+                    f"syncs carry '# graftcheck: ignore[host-sync]' with a "
+                    f"rationale"))
+    return out
+
+
+# -- lock lint ----------------------------------------------------------------
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lint_class_locks(path: str, cls: ast.ClassDef) -> List[Finding]:
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    locks: Set[str] = set()
+    threadsafe: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            tname = _terminal_name(node.value.func)
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                if tname in _LOCK_TYPES:
+                    locks.add(attr)
+                elif tname in _THREADSAFE_TYPES:
+                    threadsafe.add(attr)
+    if not locks:
+        return []
+
+    def lock_of_with(item: ast.withitem) -> Optional[str]:
+        attr = _self_attr(item.context_expr)
+        return attr if attr in locks else None
+
+    # Pass 1: guarded set — self attrs WRITTEN inside `with self.<lock>`
+    # (assignment, subscript store/del, or a known mutating method call).
+    # Written-under-lock is the signal that the lock owns the attribute;
+    # attrs only ever READ under a lock are usually immutable dependencies
+    # (config, clients) and flagging them would bury the real races.
+    guarded: Dict[str, Set[str]] = {}          # attr -> {locks guarding it}
+
+    def written_attr(node: ast.AST) -> Optional[str]:
+        attr = _self_attr(node)
+        if attr is not None and isinstance(node.ctx, (ast.Store, ast.Del)):
+            return attr
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, (ast.Store, ast.Del)):
+            return _self_attr(node.value)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            return _self_attr(node.func.value)
+        return None
+
+    def scan_with_blocks(node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.With):
+                continue
+            held = {lk for item in sub.items
+                    for lk in [lock_of_with(item)] if lk}
+            if not held:
+                continue
+            # Shallow walk, mirroring check_body: a write inside a nested
+            # def/lambda under the with-block runs LATER (usually on a
+            # worker thread) and must not count as written-under-lock.
+            for inner in _walk_shallow(sub):
+                attr = written_attr(inner)
+                if attr and attr not in locks and attr not in threadsafe \
+                        and attr not in methods:
+                    guarded.setdefault(attr, set()).update(held)
+
+    for m in methods.values():
+        scan_with_blocks(m)
+    if not guarded:
+        return []
+
+    # Pass 2: accesses of guarded attrs outside their lock.
+    out: List[Finding] = []
+
+    def check_body(nodes: Iterable[ast.AST], held: Set[str],
+                   method_name: str) -> None:
+        for node in nodes:
+            if isinstance(node, ast.With):
+                now = set(held)
+                for item in node.items:
+                    lk = lock_of_with(item)
+                    if lk:
+                        now.add(lk)
+                check_body(ast.iter_child_nodes(node), now, method_name)
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                # Closures run later, often on another thread: lock NOT held.
+                check_body(ast.iter_child_nodes(node), set(), method_name)
+                continue
+            attr = _self_attr(node)
+            if attr in guarded and not (guarded[attr] & held):
+                out.append(Finding(
+                    "lock-guard", path, node.lineno,
+                    f"{cls.name}.{method_name} touches self.{attr} without "
+                    f"holding {'/'.join(sorted(guarded[attr]))} (guards it "
+                    f"elsewhere); hold the lock, rename the helper "
+                    f"*_locked, or suppress with a rationale"))
+            check_body(ast.iter_child_nodes(node), held, method_name)
+
+    for name, m in methods.items():
+        if name == "__init__" or name.endswith("_locked"):
+            continue
+        check_body(iter(m.body), set(), name)
+    return out
+
+
+# -- driver -------------------------------------------------------------------
+
+def lint_source(path: str, source: str) -> List[Finding]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("syntax-error", path, e.lineno or 0, str(e.msg))]
+    scopes = _Scopes(tree)
+    traced = _collect_traced(tree, scopes)
+    np_aliases = _numpy_aliases(tree)
+
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if id(node) in traced and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            label = getattr(node, "name", "<lambda>")
+            findings.extend(
+                _lint_traced_body(path, node, np_aliases, label))
+        elif isinstance(node, ast.ClassDef):
+            findings.extend(_lint_class_locks(path, node))
+    findings.extend(_lint_module_wide(path, tree, traced))
+    return apply_suppressions(findings, parse_suppressions(source))
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git", ".venv")]
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return out
+
+
+def run_astlint(paths: Iterable[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        with open(path, "r", encoding="utf-8") as fh:
+            findings.extend(lint_source(path, fh.read()))
+    return findings

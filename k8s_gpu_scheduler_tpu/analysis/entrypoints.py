@@ -1,0 +1,196 @@
+"""The repo's jitted entry points, as auditable scenarios.
+
+One registry, two consumers:
+
+- ``jaxpr_entrypoints()`` → (name, fn, args) triples for the jaxpr audit
+  (tracing only — toy shapes, no compilation);
+- ``recompile_scenarios()`` → (name, build) pairs for the steady-state
+  retrace + donation audit (compiles at toy shapes, dispatches a few
+  times).
+
+Everything is built at ``LlamaConfig.tiny`` scale: the properties being
+audited (captured constants, upcasts, host transfers, retraces, donation)
+are shape-independent, and toy shapes keep the whole dynamic pass under a
+few seconds on CPU.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, List, Tuple
+
+import numpy as np
+
+
+def _tiny():
+    import jax
+
+    from ..models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def jaxpr_entrypoints() -> List[Tuple[str, Callable, tuple]]:
+    """(name, fn, example_args) for every traced entry point."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..models import serving
+    from ..models.llama import make_train_step
+    from ..ops.decode_attention import (
+        dense_decode_reference, flash_decode_attention,
+    )
+
+    cfg, params = _tiny()
+    prompt = jnp.zeros((2, 8), jnp.int32)
+    entries: List[Tuple[str, Callable, tuple]] = [
+        ("llama_generate",
+         partial(serving.generate, cfg=cfg, max_new=4, max_len=32),
+         (params, prompt)),
+    ]
+
+    opt = optax.adamw(1e-3)
+    state = jax.eval_shape(opt.init, params)          # structure only
+    state = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), state)
+    batch = {"tokens": prompt, "targets": prompt}
+    entries.append(("llama_train_step", make_train_step(cfg, None, opt),
+                    (params, state, batch)))
+
+    # ContinuousBatcher dispatches (int8-KV mode exercises every operand).
+    eng = serving.ContinuousBatcher(params, cfg, n_slots=2, max_len=32,
+                                    chunk=2, prefill_bucket=4,
+                                    kv_dtype="int8")
+    slots = np.zeros((2,), np.int32)
+    curs = np.full((2,), 4, np.int32)
+    tokens = np.zeros((2, 4), np.int32)
+    lens = np.full((2,), 4, np.int32)
+    entries.append((
+        "batcher_prefill", eng._prefill,
+        (params, eng._k, eng._v, eng._ks, eng._vs, eng._bitmap,
+         eng._rope_pos, eng._last, slots, curs, tokens, lens, np.int32(1))))
+    entries.append((
+        "batcher_decode", eng._decode,
+        (params, eng._k, eng._v, eng._ks, eng._vs, eng._bitmap,
+         np.int32(4), eng._rope_pos, eng._last,
+         np.asarray([True, False]), np.int32(2))))
+
+    # Pipeline train step (pp >= 2 needs >= 2 local devices; conftest/CLI
+    # request an 8-device CPU mesh before jax initializes).
+    if len(jax.devices()) >= 2:
+        from jax.sharding import Mesh
+
+        from ..models.pipeline import pp_loss_fn
+
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("pp",))
+        entries.append((
+            "pipeline_loss_grad",
+            jax.value_and_grad(partial(pp_loss_fn, cfg=cfg, mesh=mesh,
+                                       microbatches=2)),
+            (params, {"tokens": jnp.zeros((4, 8), jnp.int32),
+                      "targets": jnp.zeros((4, 8), jnp.int32)})))
+
+    # Decode attention, fused and dense (interpret mode traces the kernel).
+    q = jnp.zeros((2, 8, 8), jnp.bfloat16)
+    kc = jnp.zeros((2, 64, 8, 8), jnp.bfloat16)
+    lengths = jnp.full((2,), 17, jnp.int32)
+    entries.append(("flash_decode_attention",
+                    partial(flash_decode_attention, interpret=True),
+                    (q, kc, kc, lengths)))
+    entries.append(("dense_decode_reference",
+                    lambda q, k, v, n: dense_decode_reference(
+                        q, k, v, lengths=n),
+                    (q, kc, kc, lengths)))
+    return entries
+
+
+# -- steady-state decode / donation scenarios ---------------------------------
+
+def _batcher_scenario() -> tuple:
+    """warmup: one request end-to-end (compiles prefill rung + decode).
+    steady: three more waves with DIFFERENT prompt lengths on the same
+    bucket rung and different fill bitmaps — by design one compiled
+    program serves them all, so the tracked jit caches must not grow."""
+    from ..models.serving import ContinuousBatcher
+
+    cfg, params = _tiny()
+    eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=32, chunk=2,
+                            prefill_bucket=8, kv_dtype="int8")
+    rng = np.random.default_rng(0)
+
+    def warmup():
+        eng.submit(rng.integers(0, cfg.vocab, 5), max_new=3)
+        eng.run()
+
+    def wave(plen: int):
+        def go():
+            eng.submit(rng.integers(0, cfg.vocab, plen), max_new=3)
+            eng.submit(rng.integers(0, cfg.vocab, plen - 1), max_new=2)
+            eng.run()
+        return go
+
+    steady = [wave(4), wave(6), wave(8)]
+    return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
+
+
+def _generate_scenario() -> tuple:
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.serving import make_server_step
+
+    cfg, params = _tiny()
+    handler = make_server_step(cfg, None, max_new=3, max_len=32)
+    prompt = jnp.zeros((2, 8), jnp.int32)
+
+    def warmup():
+        jax.block_until_ready(handler(params, prompt))  # graftcheck: ignore[host-sync] — warmup barrier in the audit harness itself
+
+    def steady():
+        handler(params, prompt)
+
+    return warmup, [steady, steady], {"generate": handler}
+
+
+def recompile_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
+    return [
+        ("batcher_steady_decode", _batcher_scenario),
+        ("generate_steady_state", _generate_scenario),
+    ]
+
+
+def donation_audit() -> List:
+    """Verify the serving donation contracts actually hold: the batcher's
+    decode dispatch (caches + scale planes + bitmap, serving.py
+    donate_argnums=(1..5)) and the train step (params + opt state). The
+    engines/args are throwaways — donation consumes them."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..models.llama import make_train_step
+    from ..models.serving import ContinuousBatcher
+    from .recompile import check_donation, check_donation_leaves
+
+    findings = []
+    cfg, params = _tiny()
+    eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=32, chunk=2,
+                            prefill_bucket=4, kv_dtype="int8")
+    args = (params, eng._k, eng._v, eng._ks, eng._vs, eng._bitmap,
+            np.int32(0), eng._rope_pos, eng._last,
+            np.asarray([True, True]), np.int32(1))
+    findings += check_donation(eng._decode, *args, donated=(1, 2, 3, 4, 5),
+                               name="batcher_decode")
+
+    opt = optax.adamw(1e-3)
+    state = jax.jit(opt.init)(params)
+    step = make_train_step(cfg, None, opt)
+    prompt = jnp.zeros((2, 8), jnp.int32)
+    batch = {"tokens": prompt, "targets": prompt}
+    # Pytree arguments: donation is per LEAF, so probe the flattened
+    # params/opt-state buffers rather than argument positions.
+    findings += check_donation_leaves(
+        step, (params, state, batch), jax.tree.leaves((params, state)),
+        name="llama_train_step")
+    return findings

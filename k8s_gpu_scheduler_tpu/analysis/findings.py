@@ -1,0 +1,124 @@
+"""Finding model + suppression parsing for graftcheck (analysis/).
+
+A Finding is one (rule, file:line, message) triple; every pass returns a
+list of them and the CLI renders/exits on the union. Suppression is
+line-anchored source comments:
+
+    # graftcheck: ignore[rule-a,rule-b]   — suppress those rules on this line
+    # graftcheck: ignore                  — suppress every rule on this line
+
+Suppressions are deliberate, reviewable artifacts: the policy (README
+"graftcheck" section) is that each one carries a rationale in the
+surrounding comment, so a sanctioned host sync or a GIL-atomic lock-free
+read is documented where it happens instead of silently exempted.
+
+This module must stay import-light (no jax): the AST lint and the CLI's
+fast path load it before anything heavy.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set
+
+_SUPPRESS_RE = re.compile(
+    r"#.*?graftcheck:\s*ignore(?P<bracket>\[(?P<rules>[^\]]*)\])?")
+_RULE_NAME_RE = re.compile(r"^[a-z0-9_-]+$")
+
+# Sentinel entry meaning "every rule suppressed on this line".
+ALL_RULES = "*"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # file path, or a logical anchor like "<jaxpr:generate>"
+    line: int          # 1-based; 0 when the finding has no line anchor
+    message: str
+    severity: str = "error"   # "error" fails the run; "warning" reports only
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.rule}] {self.message}"
+
+
+def _iter_comments(source: str):
+    """(lineno, col, text) for every REAL comment token — tokenizing (not
+    regexing raw lines) so a marker inside a string literal or docstring
+    can never register as a suppression. Falls back to nothing on a
+    tokenize error (the lint reports the syntax error separately)."""
+    import io
+    import tokenize
+
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.start[1], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return
+
+
+def parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """Map 1-based line number -> set of suppressed rule names (ALL_RULES
+    for a bare ``ignore``). A trailing comment covers its own line; a
+    comment-ONLY line (nothing but whitespace before the ``#``) covers the
+    next line too, for statements too long to carry the comment inline."""
+    lines = source.splitlines()
+    out: Dict[int, Set[str]] = {}
+    for lineno, col, text in _iter_comments(source):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        if m.group("bracket") is None:
+            ruleset = {ALL_RULES}            # bare `ignore` — explicit
+        else:
+            # Bracketed form: only well-formed kebab-case rule names
+            # count. A typo (`[HOST-SYNC]`, `[host sync]`) must suppress
+            # NOTHING — degrading to suppress-all would make the typo
+            # invisible forever.
+            ruleset = {r.strip() for r in m.group("rules").split(",")
+                       if _RULE_NAME_RE.match(r.strip())}
+            if not ruleset:
+                continue
+        out.setdefault(lineno, set()).update(ruleset)
+        before = lines[lineno - 1][:col] if lineno <= len(lines) else ""
+        if not before.strip():
+            out.setdefault(lineno + 1, set()).update(ruleset)
+    return out
+
+
+def apply_suppressions(findings: Iterable[Finding],
+                       suppressions: Dict[int, Set[str]]) -> List[Finding]:
+    kept = []
+    for f in findings:
+        sup = suppressions.get(f.line, ())
+        if ALL_RULES in sup or f.rule in sup:
+            continue
+        kept.append(f)
+    return kept
+
+
+@dataclass
+class Report:
+    """Accumulated findings across passes, with per-pass wall time so the
+    bench leg can track lint latency."""
+    findings: List[Finding] = field(default_factory=list)
+    pass_seconds: Dict[str, float] = field(default_factory=dict)
+
+    def extend(self, findings: Iterable[Finding]) -> None:
+        self.findings.extend(findings)
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    def render(self, header: Optional[str] = None) -> str:
+        lines = []
+        if header:
+            lines.append(header)
+        for f in sorted(self.findings, key=lambda f: (f.path, f.line, f.rule)):
+            lines.append(f.render())
+        n_err = len(self.errors)
+        n_warn = len(self.findings) - n_err
+        lines.append(f"graftcheck: {n_err} error(s), {n_warn} warning(s)")
+        return "\n".join(lines)

@@ -1,0 +1,171 @@
+"""jaxpr audit — trace jitted entry points and inspect the ClosedJaxpr.
+
+Works on whatever ``jax.make_jaxpr`` returns for an entry point (tracing
+only — nothing is compiled or executed), so it is cheap enough to run on
+every lint invocation. Rules:
+
+- ``captured-const``: a closed-over constant bigger than the threshold.
+  Weights captured by value (a lambda closing over params, a
+  ``partial``-bound table) are baked into EVERY compiled program: memory
+  bloat now, a full retrace+recompile whenever the host rebinds them.
+  Entry points must take big arrays as ARGUMENTS.
+- ``f32-upcast``: a convert to float32 whose result exceeds the element
+  threshold, or a matmul mixing bf16/f16 against f32 (XLA silently
+  upcasts the whole contraction to f32 — 2x the FLOPs and bytes of the
+  bf16 path). Small f32 islands (norm/softmax stats) are the documented
+  numerics convention and stay under the threshold by construction.
+- ``dead-output``: an equation with no effects whose outputs nothing
+  consumes — compute that survives because make_jaxpr does not DCE, i.e.
+  a forgotten intermediate that XLA may or may not remove.
+- ``host-transfer``: ``device_put`` / host callbacks inside the traced
+  program; ERROR severity inside a ``scan``/``while`` body (a per-
+  iteration host round trip in the hot loop), warning at top level.
+
+Thresholds are deliberate: entry points are audited at TOY shapes
+(tiny config), so anything that scales with the model is small and only
+genuinely suspicious tensors cross the line.
+"""
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Optional
+
+from .findings import Finding
+
+CONST_BYTES_LIMIT = 1 << 20            # 1 MiB closed-over constant
+F32_ELEMS_LIMIT = 1 << 16              # 64k-element f32 intermediate
+DEAD_ELEMS_LIMIT = 1 << 12             # dead outputs below this are noise
+#   (autodiff litters traces with dead sign/convert scalars; only a dead
+#    tensor of real size indicates forgotten compute)
+
+_CALLBACK_PRIMS = {"debug_callback", "pure_callback", "io_callback",
+                   "callback"}
+_LOOP_PRIMS = {"scan", "while"}
+# Primitives that legitimately produce values nothing consumes (effects,
+# bookkeeping) or that DCE reasoning should not second-guess.
+_DEAD_OK_PRIMS = _CALLBACK_PRIMS | {"custom_jvp_call", "custom_vjp_call"}
+
+
+def _iter_subjaxprs(params: dict) -> Iterable[tuple]:
+    """(jaxpr, is_loop_body) for every sub-jaxpr in an eqn's params."""
+    import jax.core as jc
+
+    def jaxpr_of(v: Any):
+        if isinstance(v, jc.ClosedJaxpr):
+            return v.jaxpr
+        if isinstance(v, jc.Jaxpr):
+            return v
+        return None
+
+    for key, val in params.items():
+        vals = val if isinstance(val, (tuple, list)) else [val]
+        for v in vals:
+            j = jaxpr_of(v)
+            if j is not None:
+                yield key, j
+
+
+def audit_jaxpr(closed, name: str,
+                const_bytes_limit: int = CONST_BYTES_LIMIT,
+                f32_elems_limit: int = F32_ELEMS_LIMIT) -> List[Finding]:
+    """Audit one ClosedJaxpr (from ``jax.make_jaxpr(fn)(*args)``)."""
+    anchor = f"<jaxpr:{name}>"
+    findings: List[Finding] = []
+
+    for i, const in enumerate(getattr(closed, "consts", ()) or ()):
+        nbytes = getattr(const, "nbytes", 0)
+        if nbytes and nbytes > const_bytes_limit:
+            shape = getattr(const, "shape", ())
+            dtype = getattr(const, "dtype", "?")
+            findings.append(Finding(
+                "captured-const", anchor, 0,
+                f"{name} closes over a {nbytes / 2**20:.1f} MiB constant "
+                f"(shape {tuple(shape)}, {dtype}): weights captured by "
+                f"value recompile on rebind and bloat every executable — "
+                f"pass it as an argument (const #{i})"))
+
+    def visit(jaxpr, in_loop: bool) -> None:
+        used = set()
+        for eqn in jaxpr.eqns:
+            for v in eqn.invars:
+                used.add(id(v))
+        for v in jaxpr.outvars:
+            used.add(id(v))
+
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim == "convert_element_type":
+                new_dtype = str(eqn.params.get("new_dtype", ""))
+                out = eqn.outvars[0].aval
+                if new_dtype == "float32" and out.size > f32_elems_limit \
+                        and str(eqn.invars[0].aval.dtype) in ("bfloat16",
+                                                              "float16"):
+                    findings.append(Finding(
+                        "f32-upcast", anchor, 0,
+                        f"{name}: {out.size}-element "
+                        f"{tuple(out.shape)} upcast to f32 from "
+                        f"{eqn.invars[0].aval.dtype} — a full-size f32 "
+                        f"intermediate in a bf16 path (2x HBM)",
+                        severity="error"))
+            elif prim == "dot_general":
+                dts = {str(v.aval.dtype) for v in eqn.invars
+                       if hasattr(v.aval, "dtype")}
+                if "float32" in dts and dts & {"bfloat16", "float16"}:
+                    findings.append(Finding(
+                        "f32-upcast", anchor, 0,
+                        f"{name}: dot_general mixes {sorted(dts)} — XLA "
+                        f"upcasts the whole contraction to f32; cast the "
+                        f"f32 operand down (or keep stats out of matmuls)"))
+            elif prim == "device_put" or prim in _CALLBACK_PRIMS:
+                what = ("host callback" if prim in _CALLBACK_PRIMS
+                        else "device_put")
+                findings.append(Finding(
+                    "host-transfer", anchor, 0,
+                    f"{name}: {what} ({prim}) "
+                    + ("inside a scan/while body — a host round trip per "
+                       "iteration of the hot loop" if in_loop
+                       else "in the traced program"),
+                    severity="error" if in_loop else "warning"))
+
+            if not eqn.effects and eqn.outvars \
+                    and prim not in _DEAD_OK_PRIMS \
+                    and prim not in _LOOP_PRIMS:
+                import jax.core as jc
+
+                live = [v for v in eqn.outvars
+                        if not isinstance(v, jc.DropVar) and id(v) in used]
+                big = max((getattr(v.aval, "size", 0)
+                           for v in eqn.outvars), default=0)
+                if not live and big > DEAD_ELEMS_LIMIT:
+                    # Every output is dropped or unused — the tail of a
+                    # dead compute chain (tracing keeps it; XLA usually
+                    # DCEs, but the source is still paying trace cost and
+                    # hiding intent). Tiny dead scalars (autodiff
+                    # byproducts) stay under DEAD_ELEMS_LIMIT.
+                    findings.append(Finding(
+                        "dead-output", anchor, 0,
+                        f"{name}: {prim} output "
+                        f"{[str(v.aval) for v in eqn.outvars]} is never "
+                        f"consumed — dead compute that make_jaxpr keeps "
+                        f"and XLA may not remove",
+                        severity="warning"))
+
+            for _key, sub in _iter_subjaxprs(eqn.params):
+                visit(sub, in_loop or prim in _LOOP_PRIMS)
+
+    visit(closed.jaxpr, in_loop=False)
+    return findings
+
+
+def audit_callable(fn, args, name: str, **limits) -> List[Finding]:
+    """Trace ``fn(*args)`` with make_jaxpr and audit the result. Tracing
+    failures become findings instead of crashes, so one broken entry point
+    cannot hide the others."""
+    import jax
+
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # noqa: BLE001 — report, keep auditing
+        return [Finding("trace-error", f"<jaxpr:{name}>", 0,
+                        f"could not trace {name}: {type(e).__name__}: "
+                        f"{str(e)[:300]}")]
+    return audit_jaxpr(closed, name, **limits)

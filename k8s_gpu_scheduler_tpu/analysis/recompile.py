@@ -1,0 +1,172 @@
+"""Recompile guard — jit cache-miss accounting + donation verification.
+
+Steady-state retracing is the quietest way to lose 10-100x serving
+throughput: the program still produces correct tokens, every dispatch
+just pays trace+compile again because a shape, a weak type, or a Python
+scalar changed identity. The guard makes that a test failure:
+
+- ``RecompileGuard`` wraps/adopts a jitted callable and exposes the jit
+  cache size (``jax.jit``'s ``_cache_size``) as a miss counter:
+  ``snapshot()`` then ``misses_since()`` bounds a steady-state region.
+- ``assert_no_retrace`` is the context-manager form: any tracked entry
+  point that retraces inside the block raises with the per-entry delta.
+- Donation verification: XLA tells us two ways when a ``donate_argnums``
+  contract silently broke — the "Some donated buffers were not usable"
+  warning at dispatch, and the donated input buffer NOT being deleted
+  afterwards. ``check_donation`` captures both.
+
+The tier-1 hook is the ``recompile_guard`` pytest fixture
+(tests/conftest.py) built on these; the CLI's dynamic pass
+(analysis/__main__.py --recompile) runs the same steady-state-decode
+check over the serving entry points.
+"""
+from __future__ import annotations
+
+import contextlib
+import warnings
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .findings import Finding
+
+
+def _cache_size(jitted) -> Optional[int]:
+    """jit cache entry count, or None when the callable does not expose
+    it (not a jax.jit product)."""
+    probe = getattr(jitted, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:  # noqa: BLE001 — treat as untrackable
+        return None
+
+
+class RecompileGuard:
+    """Track jit cache misses for a set of named jitted callables."""
+
+    def __init__(self) -> None:
+        self._tracked: Dict[str, object] = {}
+        self._marks: Dict[str, int] = {}
+
+    def track(self, name: str, jitted) -> None:
+        if _cache_size(jitted) is None:
+            raise TypeError(
+                f"{name}: not a trackable jitted callable (no _cache_size); "
+                f"pass the jax.jit product itself, not a plain function")
+        self._tracked[name] = jitted
+
+    @property
+    def snapshotted(self) -> bool:
+        """True once snapshot() has run — teardown hooks key off this
+        instead of reaching into internals."""
+        return bool(self._marks)
+
+    def snapshot(self) -> Dict[str, int]:
+        self._marks = {n: _cache_size(f) or 0
+                       for n, f in self._tracked.items()}
+        return dict(self._marks)
+
+    def misses_since(self) -> Dict[str, int]:
+        # max(0, ...): a cache cleared/evicted between snapshot and check
+        # (jax.clear_caches) yields a negative delta, which is not a
+        # retrace.
+        return {n: max(0, (_cache_size(f) or 0) - self._marks.get(n, 0))
+                for n, f in self._tracked.items()}
+
+    def assert_steady_state(self) -> None:
+        misses = {n: d for n, d in self.misses_since().items() if d > 0}
+        if misses:
+            raise AssertionError(
+                f"steady-state retrace detected (jit cache misses since "
+                f"snapshot): {misses} — a shape/dtype/static-arg changed "
+                f"identity between dispatches")
+
+
+@contextlib.contextmanager
+def assert_no_retrace(named: Dict[str, object]):
+    """``with assert_no_retrace({'decode': eng._decode}): ...`` — raises
+    AssertionError on exit if any tracked entry point retraced inside."""
+    guard = RecompileGuard()
+    for name, fn in named.items():
+        guard.track(name, fn)
+    guard.snapshot()
+    yield guard
+    guard.assert_steady_state()
+
+
+_DONATION_WARNING = "donated buffers were not usable"
+
+
+def check_donation_leaves(jitted, args: tuple, leaves: Sequence,
+                          name: str = "fn") -> List[Finding]:
+    """Dispatch ``jitted(*args)`` and verify the donation contract for the
+    given donated buffers (already-flattened leaves): no 'not usable'
+    warning during the call, and every leaf actually deleted afterwards —
+    an aliasing/sharding mismatch leaves it alive, the silent un-donation
+    this audits for. The call's result is discarded; callers pass
+    throwaway inputs."""
+    findings: List[Finding] = []
+    anchor = f"<donation:{name}>"
+    probeable = [buf for buf in leaves
+                 if getattr(buf, "is_deleted", None) is not None]
+    if leaves and not probeable:
+        # Nothing to verify is itself a finding: host/numpy arrays have no
+        # deletion state, so a "clean" result would mean the audit checked
+        # nothing at all.
+        return [Finding(
+            "donation-unverifiable", anchor, 0,
+            f"{name}: none of the {len(leaves)} donated leaves expose "
+            f"is_deleted — pass device buffers, not host arrays")]
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        jitted(*args)
+    for w in caught:
+        if _DONATION_WARNING in str(w.message):
+            findings.append(Finding(
+                "donation-broken", anchor, 0,
+                f"{name}: {w.message}"))
+    alive = [buf for buf in probeable if not buf.is_deleted()]
+    for buf in alive:
+        findings.append(Finding(
+            "donation-broken", anchor, 0,
+            f"{name}: donated buffer ({getattr(buf, 'shape', '?')}, "
+            f"{getattr(buf, 'dtype', '?')}) was NOT consumed — still alive "
+            f"after dispatch, so every call holds two full copies"))
+    return findings
+
+
+def check_donation(jitted, *args, donated: Sequence[int],
+                   name: str = "fn") -> List[Finding]:
+    """``check_donation_leaves`` keyed by positional argument index."""
+    return check_donation_leaves(
+        jitted, args, [args[pos] for pos in donated], name=name)
+
+
+def audit_steady_state(build: Callable[[], tuple],
+                       name: str) -> List[Finding]:
+    """Run one (warmup_fn, steady_fns, tracked) scenario from ``build``:
+    ``warmup_fn()`` compiles everything, then each fn in ``steady_fns``
+    runs with retraces counted across the named ``tracked`` jitted
+    callables. Used by the CLI's --recompile pass; exceptions become
+    findings so a broken scenario cannot mask the others."""
+    anchor = f"<recompile:{name}>"
+    try:
+        warmup_fn, steady_fns, tracked = build()
+        warmup_fn()
+        guard = RecompileGuard()
+        for n, f in tracked.items():
+            guard.track(n, f)
+        guard.snapshot()
+        for fn in steady_fns:
+            fn()
+        misses = {n: d for n, d in guard.misses_since().items() if d}
+    except Exception as e:  # noqa: BLE001 — report, keep auditing
+        return [Finding("recompile-guard", anchor, 0,
+                        f"scenario {name} failed to run: "
+                        f"{type(e).__name__}: {str(e)[:300]}")]
+    if misses:
+        return [Finding(
+            "steady-state-retrace", anchor, 0,
+            f"{name}: jit cache misses after warmup: {misses} — "
+            f"steady-state decode must not retrace")]
+    return []

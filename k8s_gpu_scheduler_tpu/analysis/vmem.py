@@ -1,0 +1,204 @@
+"""Pallas VMEM budgeter — static footprint estimates for the ops/ kernels.
+
+A Pallas TPU kernel that oversubscribes VMEM fails at Mosaic compile time
+ON THE TPU — i.e. in production, at whatever new (config, block) pair
+first exceeds the budget — while CPU interpret-mode tier-1 sails through
+because interpret mode has no VMEM. This pass moves that failure to lint
+time: it recomputes each kernel's VMEM working set from the SAME block
+shapes the wrapper would choose (``decode_plan`` for the flash-decode
+kernel, the ``block_q``/``block_k`` defaults for training flash
+attention) and checks it against the ~16 MiB/core budget, for every
+``LlamaConfig`` preset the repo actually serves or benches.
+
+Footprint model (the standard Mosaic accounting):
+
+- every grid-streamed input/output block is DOUBLE-buffered (the pipeline
+  overlaps the next block's DMA with this block's compute), so block
+  bytes count twice;
+- scratch (``pltpu.VMEM`` shapes) is single-buffered;
+- a conservative fraction of the 16 MiB is reserved for Mosaic's own
+  spills/temporaries (default 10%).
+
+The block-divisibility side of the same contract is checked here too: a
+preset whose cache length has no legal ``decode_plan`` blocking would
+silently fall back to the dense path (a perf cliff, not a crash), and a
+``max_seq`` the training flash kernel's default blocks don't divide
+raises at trace time on the training path.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .findings import Finding
+
+VMEM_BYTES_PER_CORE = 16 * 2 ** 20
+# Fraction of VMEM the estimator may budget for kernel blocks+scratch;
+# the rest absorbs Mosaic temporaries and sublane padding slack.
+VMEM_USABLE_FRACTION = 0.9
+
+_LANES = 128
+_DTYPE_BYTES = {"bfloat16": 2, "float32": 4, "int8": 1, "float16": 2,
+                "int32": 4, "bool": 1}
+
+
+def _nbytes(shape: Tuple[int, ...], dtype: str) -> int:
+    n = 1
+    for d in shape:
+        n *= d
+    return n * _DTYPE_BYTES[dtype]
+
+
+@dataclass(frozen=True)
+class KernelFootprint:
+    name: str
+    in_blocks: int        # double-buffered
+    out_blocks: int       # double-buffered
+    scratch: int          # single-buffered
+    notes: str = ""
+
+    @property
+    def total(self) -> int:
+        return 2 * (self.in_blocks + self.out_blocks) + self.scratch
+
+    def check(self, budget: int = VMEM_BYTES_PER_CORE,
+              usable_fraction: float = VMEM_USABLE_FRACTION,
+              anchor: str = "") -> List[Finding]:
+        usable = int(budget * usable_fraction)
+        if self.total <= usable:
+            return []
+        return [Finding(
+            "vmem-budget", anchor or f"<vmem:{self.name}>", 0,
+            f"{self.name}: estimated VMEM working set "
+            f"{self.total / 2**20:.2f} MiB exceeds the usable "
+            f"{usable / 2**20:.1f} MiB of the {budget / 2**20:.0f} MiB/core "
+            f"budget ({self.notes})")]
+
+
+def decode_attention_footprint(
+    s: int, g: int, hd: int, block_k: int,
+    kv_dtype: str = "bfloat16", quant: bool = False, bitmap: bool = False,
+    q_dtype: str = "bfloat16",
+) -> KernelFootprint:
+    """Working set of ops/decode_attention._decode_kernel for one grid
+    program: q block [1, g, hd], k/v blocks [1, block_k, 1, hd] (int8 in
+    quant mode plus f32 scale planes), optional bitmap block, three
+    partial outputs, and the (acc, m, l) f32 scratch."""
+    kv_d = "int8" if quant else kv_dtype
+    in_blocks = _nbytes((1, g, hd), q_dtype) \
+        + 2 * _nbytes((1, block_k, 1, hd), kv_d)
+    if quant:
+        in_blocks += 2 * _nbytes((1, block_k, 1, 1), "float32")
+    if bitmap:
+        in_blocks += _nbytes((1, block_k), "int8")
+    out_blocks = _nbytes((1, 1, g, hd), "float32") \
+        + 2 * _nbytes((1, 1, g, _LANES), "float32")
+    scratch = _nbytes((g, hd), "float32") + 2 * _nbytes((g, _LANES), "float32")
+    return KernelFootprint(
+        name=f"flash_decode(S={s}, block_k={block_k}, g={g}, hd={hd}, "
+             f"kv={'int8' if quant else kv_dtype})",
+        in_blocks=in_blocks, out_blocks=out_blocks, scratch=scratch,
+        notes=f"block_k={block_k}, double-buffered blocks",
+    )
+
+
+def flash_attention_footprint(
+    block_q: int, block_k: int, d: int, dtype: str = "bfloat16",
+    with_lse: bool = True, backward: bool = False,
+) -> KernelFootprint:
+    """Working set of the training flash kernels (ops/flash_attention.py).
+    Forward: q/k/v blocks in, out (+lse) blocks out, (m, l, acc) scratch.
+    Backward (the dkv kernel — strictly the larger of the two): six input
+    blocks, two output blocks, two f32 accumulators."""
+    if not backward:
+        in_blocks = _nbytes((1, block_q, d), dtype) \
+            + 2 * _nbytes((1, block_k, d), dtype)
+        out_blocks = _nbytes((1, block_q, d), dtype)
+        if with_lse:
+            out_blocks += _nbytes((1, block_q, _LANES), "float32")
+        scratch = 2 * _nbytes((block_q, _LANES), "float32") \
+            + _nbytes((block_q, d), "float32")
+        name = f"flash_fwd(bq={block_q}, bk={block_k}, d={d})"
+    else:
+        in_blocks = 4 * _nbytes((1, block_q, d), dtype) \
+            + 2 * _nbytes((1, block_k, d), dtype) \
+            + _nbytes((1, block_q, _LANES), "float32")
+        out_blocks = 2 * _nbytes((1, block_k, d), dtype)
+        scratch = 2 * _nbytes((block_k, d), "float32")
+        name = f"flash_bwd_dkv(bq={block_q}, bk={block_k}, d={d})"
+    return KernelFootprint(name=name, in_blocks=in_blocks,
+                           out_blocks=out_blocks, scratch=scratch,
+                           notes="double-buffered blocks")
+
+
+# -- preset audit -------------------------------------------------------------
+
+def _presets() -> List[Tuple[str, "object", Dict]]:
+    """Every LlamaConfig the repo actually runs, with the serving cache
+    lengths it runs them at. Kept HERE (not scattered) so adding a preset
+    to serving/bench without extending the audit is a conscious choice."""
+    from ..models.llama import LlamaConfig
+
+    serve_cfg = LlamaConfig(                 # models/llama.py main --serve
+        vocab=32000, d_model=1024, n_layers=8, n_heads=16, n_kv_heads=16,
+        d_ff=4096, max_seq=2048, remat=False)
+    longctx_cfg = LlamaConfig(               # bench.py _bench_serving_longctx
+        vocab=32000, d_model=1024, n_layers=4, n_heads=16, n_kv_heads=16,
+        d_ff=4096, max_seq=8192, remat=False)
+    full8b_cfg = LlamaConfig(                # bench.py _bench_serving_8b_full
+        vocab=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq=1024, remat=False)
+    return [
+        ("llama3_8b", LlamaConfig.llama3_8b(), {"cache_lens": (8192,)}),
+        ("tiny", LlamaConfig.tiny(), {"cache_lens": (128,)}),
+        ("serve_1b", serve_cfg, {"cache_lens": (2048,)}),
+        ("longctx", longctx_cfg, {"cache_lens": (8192,)}),
+        ("serve_8b_full", full8b_cfg, {"cache_lens": (512, 1024)}),
+    ]
+
+
+def audit_vmem(budget: int = VMEM_BYTES_PER_CORE) -> List[Finding]:
+    """Block-divisibility + VMEM-budget audit of every kernel the presets
+    can reach: flash-decode at each preset's serving cache lengths (bf16
+    and int8-KV, with the batcher's bitmap), training flash fwd+bwd at
+    each preset's max_seq."""
+    from ..ops.decode_attention import decode_plan
+    from ..ops.flash_attention import _shrink_to_divisor
+
+    findings: List[Finding] = []
+    anchor = "k8s_gpu_scheduler_tpu/ops/decode_attention.py"
+    for name, cfg, meta in _presets():
+        g = cfg.n_heads // cfg.n_kv_heads
+        for s in meta["cache_lens"]:
+            plan = decode_plan(s)
+            if plan is None:
+                findings.append(Finding(
+                    "block-divisibility", anchor, 0,
+                    f"preset {name}: no legal (block_k, n_splits) for "
+                    f"cache length S={s} — fused decode silently falls "
+                    f"back to the dense path"))
+                continue
+            block_k, n_splits = plan
+            for quant in (False, True):
+                fp = decode_attention_footprint(
+                    s, g, cfg.head_dim, block_k, quant=quant, bitmap=True)
+                findings.extend(fp.check(budget, anchor=anchor))
+        # Training flash attention at max_seq (forward defaults 256/512;
+        # backward shrinks to <=256 divisors — mirror _resolve/_bwd).
+        t = cfg.max_seq
+        bq, bk = min(256, t), min(512, t)
+        fa_anchor = "k8s_gpu_scheduler_tpu/ops/flash_attention.py"
+        if t % bq or t % bk:
+            findings.append(Finding(
+                "block-divisibility", fa_anchor, 0,
+                f"preset {name}: max_seq {t} not divisible by the default "
+                f"flash blocks ({bq}/{bk}) — attn_impl='flash' would raise "
+                f"at trace time"))
+        else:
+            findings.extend(flash_attention_footprint(
+                bq, bk, cfg.head_dim).check(budget, anchor=fa_anchor))
+            bq_b, bk_b = _shrink_to_divisor(bq, t), _shrink_to_divisor(bk, t)
+            findings.extend(flash_attention_footprint(
+                bq_b, bk_b, cfg.head_dim, backward=True).check(
+                    budget, anchor=fa_anchor))
+    return findings

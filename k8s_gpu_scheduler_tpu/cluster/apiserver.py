@@ -51,11 +51,11 @@ class APIServer:
         self._watchers: Dict[str, List[queue.Queue]] = {}
 
     # -- helpers -----------------------------------------------------------
-    def _bump(self, obj: Any) -> None:
+    def _bump_locked(self, obj: Any) -> None:
         self._rv += 1
         obj.metadata.resource_version = self._rv
 
-    def _notify(self, kind: str, ev: WatchEvent) -> None:
+    def _notify_locked(self, kind: str, ev: WatchEvent) -> None:
         for q in self._watchers.get(kind, []):
             q.put(ev)
 
@@ -68,9 +68,9 @@ class APIServer:
             key = obj.metadata.key
             if key in bucket:
                 raise AlreadyExists(f"{kind} {key}")
-            self._bump(obj)
+            self._bump_locked(obj)
             bucket[key] = obj
-            self._notify(kind, WatchEvent("ADDED", deepcopy_obj(obj)))
+            self._notify_locked(kind, WatchEvent("ADDED", deepcopy_obj(obj)))
         return deepcopy_obj(obj)
 
     def get(self, kind: str, name: str, namespace: str = "default") -> Any:
@@ -115,9 +115,9 @@ class APIServer:
                 raise Conflict(
                     f"{kind} {key}: rv {cur.metadata.resource_version} != {expect_rv}"
                 )
-            self._bump(obj)
+            self._bump_locked(obj)
             bucket[key] = obj
-            self._notify(kind, WatchEvent("MODIFIED", deepcopy_obj(obj)))
+            self._notify_locked(kind, WatchEvent("MODIFIED", deepcopy_obj(obj)))
         return deepcopy_obj(obj)
 
     def mutate(self, kind: str, name: str, namespace: str, fn: Callable[[Any], None]) -> Any:
@@ -134,9 +134,9 @@ class APIServer:
             obj = deepcopy_obj(cur)
             fn(obj)
             stored = deepcopy_obj(obj)
-            self._bump(stored)
+            self._bump_locked(stored)
             self._store[kind][f"{namespace}/{name}"] = stored
-            self._notify(kind, WatchEvent("MODIFIED", deepcopy_obj(stored)))
+            self._notify_locked(kind, WatchEvent("MODIFIED", deepcopy_obj(stored)))
             return deepcopy_obj(stored)
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
@@ -146,7 +146,7 @@ class APIServer:
             obj = bucket.pop(key, None)
             if obj is None:
                 raise NotFound(f"{kind} {key}")
-            self._notify(kind, WatchEvent("DELETED", deepcopy_obj(obj)))
+            self._notify_locked(kind, WatchEvent("DELETED", deepcopy_obj(obj)))
 
     # -- watch -------------------------------------------------------------
     def watch(self, kind: str, send_initial: bool = True) -> "Watch":

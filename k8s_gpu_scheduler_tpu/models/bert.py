@@ -116,13 +116,14 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
     B, T = 32, 128
     tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab)
     infer = jax.jit(lambda p, t: classify(p, t, cfg))
-    infer(params, tokens).block_until_ready()  # compile
+    infer(params, tokens).block_until_ready()  # compile — graftcheck: ignore[host-sync] (sanctioned: warmup barrier)
     slo = float(os.environ.get("SLO", "0") or 0)
     from ..recommender.collector import make_workload_publisher
 
     publish = make_workload_publisher()
     while True:
         t0 = time.perf_counter()
+        # graftcheck: ignore[host-sync] — sanctioned: per-step sync IS the qps measurement of this host-paced loop
         infer(params, tokens).block_until_ready()
         step_dt = time.perf_counter() - t0
         qps = B / step_dt

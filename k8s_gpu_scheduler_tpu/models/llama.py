@@ -24,7 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import dense_attention, ring_attention, ulysses_attention
 from ..ops.layers import apply_rope, rms_norm, rope_freqs, swiglu
-from ..parallel.sharding import logical_axis_rules, spec_for
+from ..parallel.sharding import logical_axis_rules, shard_map, spec_for
 
 
 @dataclass(frozen=True)
@@ -197,7 +197,7 @@ def _attention(cfg: LlamaConfig, mesh: Optional[Mesh], q, k, v):
             # kernel per shard — attention is embarrassingly parallel over
             # (dp·fsdp, tp) when the sequence axis is whole.
             spec = P(("dp", "fsdp"), None, "tp", None)
-            fn = jax.shard_map(
+            fn = shard_map(
                 lambda q, k, v: flash_attention_diff(q, k, v, True),
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                 check_vma=False,
@@ -210,7 +210,7 @@ def _attention(cfg: LlamaConfig, mesh: Optional[Mesh], q, k, v):
     impl = (ulysses_attention if cfg.attn_impl == "ulysses"
             else ring_attention)
     spec = P(("dp", "fsdp"), "sp", "tp", None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(impl, axis_name="sp", causal=True),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -528,7 +528,7 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
 
         handler = make_server_step(cfg, mesh, max_new, max_len=cfg.max_seq)
         prompt = tokens[:, :Tp]
-        handler(params, prompt).block_until_ready()  # compile
+        handler(params, prompt).block_until_ready()  # compile — graftcheck: ignore[host-sync] (sanctioned: warmup barrier before the serve loop)
         while True:
             t0 = time.perf_counter()
             out = handler(params, prompt)
@@ -537,7 +537,7 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
             # most workers when batch is sharded over (dp, fsdp) — jax
             # raises and multi-host serving dies. block_until_ready syncs
             # on every worker without materializing remote shards.
-            jax.block_until_ready(out)
+            jax.block_until_ready(out)  # graftcheck: ignore[host-sync] — sanctioned: the documented multi-host serve-loop sync (comment above)
             dt = time.perf_counter() - t0
             b = prompt.shape[0]
             print(f"llama serve qps={b / dt:.2f} "

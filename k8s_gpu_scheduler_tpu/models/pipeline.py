@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import dense_attention
 from ..ops.layers import rms_norm, rope_freqs
+from ..parallel.sharding import shard_map
 from .llama import LlamaConfig, attn_sublayer, mlp_sublayer, param_axes
 
 
@@ -95,6 +96,11 @@ def pp_loss_fn(params: Dict, batch: Dict, cfg: LlamaConfig, mesh: Mesh,
             valid = (stage == last) & (t >= last) & (t - last < M)
             loss_sum = loss_sum + jnp.where(valid, nll, 0.0)
             n_sum = n_sum + jnp.where(valid, mb * T, 0)
+            # loss_sum/n_sum stay shape (1,), never rank-0: a SCALAR scan
+            # carry becomes a rank-0 residual of the autodiff'd shard_map,
+            # which 0.4.x shard_map cannot assign an out_spec (_SpecError
+            # "add at least one (singleton) axis") — the singleton axis is
+            # squeezed after the psum below.
             # Rotate activations one stage forward (ring; last→0 carries a
             # dead value that stage 0 overwrites with its next inject).
             nxt = jax.lax.ppermute(
@@ -103,16 +109,17 @@ def pp_loss_fn(params: Dict, batch: Dict, cfg: LlamaConfig, mesh: Mesh,
 
         act0 = jnp.zeros((mb, T, cfg.d_model), cfg.dtype)
         (_, loss_sum, n_sum), _ = jax.lax.scan(
-            tick, (act0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+            tick,
+            (act0, jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.int32)),
             jnp.arange(M + n_stages - 1))
         # Only the last stage holds the sums — psum replicates the scalar.
         total = jax.lax.psum(loss_sum, "pp")
         count = jax.lax.psum(n_sum, "pp")
-        return total / count.astype(jnp.float32)
+        return (total / count.astype(jnp.float32))[0]
 
     # Layer-stacked block leaves shard over pp; everything else replicates.
     blocks_spec = jax.tree.map(lambda _: P("pp"), params["blocks"])
-    fn = jax.shard_map(
+    fn = shard_map(
         stage_program,
         mesh=mesh,
         in_specs=(blocks_spec, P(), P(), P(), P(), P()),
@@ -179,7 +186,8 @@ def pp_1f1b_loss_and_grads(params: Dict, batch: Dict, cfg: LlamaConfig,
     P_ = n_stages
     W = 2 * (P_ - 1) + 1                    # max in-flight inputs (stage 0)
     angles = rope_freqs(cfg.head_dim, T, cfg.rope_theta)
-    total_tokens = float(B * T)
+    # B/T come from .shape — static Python ints, not tracers.
+    total_tokens = float(B * T)  # graftcheck: ignore[tracer-cast]
 
     def stage_program(blocks, embed, lm_head, final_norm, tokens, targets):
         stage = jax.lax.axis_index("pp")
@@ -283,7 +291,7 @@ def pp_1f1b_loss_and_grads(params: Dict, batch: Dict, cfg: LlamaConfig,
         return loss, gblocks, gembed, glmh, gfn
 
     blocks_spec = jax.tree.map(lambda _: P("pp"), params["blocks"])
-    fn = jax.shard_map(
+    fn = shard_map(
         stage_program,
         mesh=mesh,
         in_specs=(blocks_spec, P(), P(), P(), P(), P()),

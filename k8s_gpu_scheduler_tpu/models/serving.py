@@ -825,6 +825,7 @@ class ContinuousBatcher:
         readback and replay them, in dispatch order, into ``self._out``."""
         if not self._reads:
             return
+        # graftcheck: ignore[host-sync] — sanctioned: THE one batched readback (one tunnel round trip per drain, the engine's whole design)
         arrays = jax.device_get([arr for _, arr, _ in self._reads])
         now = time.monotonic()
         for (kind, _, meta), vals in zip(self._reads, arrays):

@@ -20,7 +20,30 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
+import jax
 from jax.sharding import PartitionSpec as P
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable ``shard_map``: newer jax exposes ``jax.shard_map``
+    with ``check_vma``; 0.4.x ships ``jax.experimental.shard_map.
+    shard_map`` with ``check_rep`` (same meaning, earlier name); a middle
+    window promoted the function to top level while still naming the
+    kwarg ``check_rep`` — so the kwarg is chosen from the actual
+    SIGNATURE, never from where the function lives. Every shard_map
+    island in models/ and the tests goes through this one shim, so a jax
+    upgrade or downgrade is a one-line change instead of a 12-test
+    breakage."""
+    import inspect
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    kwarg = ("check_vma"
+             if "check_vma" in inspect.signature(sm).parameters
+             else "check_rep")
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **{kwarg: check_vma})
 
 # logical axis -> mesh axis (None = replicate). The sp axis never shards
 # WEIGHTS — it only shards the sequence dimension of activations.

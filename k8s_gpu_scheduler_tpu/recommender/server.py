@@ -82,7 +82,12 @@ class _Table:
             version = _md5(self.path)
         except OSError:
             return False
-        if not force and version == self.version:
+        # self.version is lock-guarded state — snapshot it under _mu. Two
+        # refreshers racing here at worst both retrain (idempotent); a torn
+        # read against the locked writer is what the lock rules out.
+        with self._mu:
+            current = self.version
+        if not force and version == current:
             return False
         labels, columns, X = load_matrix(self.path)
         completed = IterativeImputer().fit_transform(X)

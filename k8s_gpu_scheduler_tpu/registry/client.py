@@ -53,7 +53,7 @@ class Client:
         self._buf = b""
 
     # -- connection --------------------------------------------------------
-    def _connect(self) -> None:
+    def _connect_locked(self) -> None:
         s = socket.create_connection((self.host, self.port), timeout=self._timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = s
@@ -87,7 +87,7 @@ class Client:
         self.close()
 
     # -- protocol ----------------------------------------------------------
-    def _send(self, argv: List[str]) -> None:
+    def _send_locked(self, argv: List[str]) -> None:
         out = [f"*{len(argv)}\r\n".encode()]
         for a in argv:
             data = a.encode() if isinstance(a, str) else a
@@ -95,7 +95,7 @@ class Client:
         assert self._sock is not None
         self._sock.sendall(b"".join(out))
 
-    def _read_line(self) -> bytes:
+    def _read_line_locked(self) -> bytes:
         assert self._sock is not None
         while b"\r\n" not in self._buf:
             chunk = self._sock.recv(4096)
@@ -105,7 +105,7 @@ class Client:
         line, self._buf = self._buf.split(b"\r\n", 1)
         return line
 
-    def _read_exact(self, n: int) -> bytes:
+    def _read_exact_locked(self, n: int) -> bytes:
         assert self._sock is not None
         while len(self._buf) < n:
             chunk = self._sock.recv(4096)
@@ -115,8 +115,8 @@ class Client:
         data, self._buf = self._buf[:n], self._buf[n:]
         return data
 
-    def _read_reply(self):
-        line = self._read_line()
+    def _read_reply_locked(self):
+        line = self._read_line_locked()
         kind, rest = line[:1], line[1:].decode()
         if kind == b"+":
             return rest
@@ -130,20 +130,20 @@ class Client:
             n = int(rest)
             if n == -1:
                 return None
-            data = self._read_exact(n + 2)[:-2]
+            data = self._read_exact_locked(n + 2)[:-2]
             return data.decode()
         if kind == b"*":
-            return [self._read_reply() for _ in range(int(rest))]
+            return [self._read_reply_locked() for _ in range(int(rest))]
         raise RegistryError(f"bad reply line: {line!r}")
 
     def _roundtrip_locked(self, argv: List[str]):
-        self._send(argv)
-        return self._read_reply()
+        self._send_locked(argv)
+        return self._read_reply_locked()
 
     def _call(self, *argv: str):
         with self._mu:
             if self._sock is None:
-                self._connect()
+                self._connect_locked()
             try:
                 return self._roundtrip_locked(list(argv))
             except (OSError, ConnectionLost) as transport_err:
@@ -159,7 +159,7 @@ class Client:
                     raise ConnectionLost(
                         f"{argv[0]} failed mid-flight (not retried)"
                     ) from transport_err
-                self._connect()
+                self._connect_locked()
                 return self._roundtrip_locked(list(argv))
 
     # -- API parity with client.go:26-67 ----------------------------------

@@ -212,7 +212,7 @@ class Cache:
             return list(self._nodes)
 
     # -- internals (call with lock held) ----------------------------------
-    def _node_info(self, node_name: str) -> NodeInfo:
+    def _node_info_locked(self, node_name: str) -> NodeInfo:
         info = self._nodes.get(node_name)
         if info is None:
             # Node not (yet) known — placeholder so accounting survives
@@ -222,7 +222,7 @@ class Cache:
         return info
 
     def _add_locked(self, node_name: str, pod: Pod) -> None:
-        info = self._node_info(node_name)
+        info = self._node_info_locked(node_name)
         for i, p in enumerate(info.pods):
             if p.metadata.uid == pod.metadata.uid:
                 info.pods[i] = pod  # already accounted — refresh only

@@ -246,6 +246,7 @@ class Nominator:
         preemption case (a bare len read is atomic under the GIL). An
         explicit method, not __bool__: truthiness on a Nominator must keep
         meaning 'exists' for `if handle.nominator:` callers."""
+        # graftcheck: ignore[lock-guard] — deliberate lock-free read: GIL-atomic, staleness acceptable (docstring above)
         return bool(self._nominated)
 
 

@@ -48,7 +48,7 @@ class SchedulingQueue:
             if pod.metadata.uid in self._queued_uids or pod.metadata.uid in self._backoff:
                 return
             self._queued_uids[pod.metadata.uid] = 0
-            self._push(pod)
+            self._push_locked(pod)
             self._mu.notify()
 
     def add_unschedulable(self, pod: Pod) -> None:
@@ -73,7 +73,7 @@ class SchedulingQueue:
         with self._mu:
             for uid, (_ready, pod) in list(self._backoff.items()):
                 del self._backoff[uid]
-                self._push(pod)
+                self._push_locked(pod)
             self._mu.notify_all()
 
     def done(self, pod: Pod) -> None:
@@ -96,7 +96,7 @@ class SchedulingQueue:
             while True:
                 if self._closed:
                     return None
-                self._promote_ready()
+                self._promote_ready_locked()
                 while self._heap:
                     _, _, _, pod = heapq.heappop(self._heap)
                     if pod.metadata.uid in self._queued_uids and pod.metadata.uid not in self._backoff:
@@ -120,16 +120,16 @@ class SchedulingQueue:
             return len(self._queued_uids)
 
     # -- internals (lock held) --------------------------------------------
-    def _push(self, pod: Pod) -> None:
+    def _push_locked(self, pod: Pod) -> None:
         heapq.heappush(
             self._heap,
             (-pod_priority(pod), pod.metadata.creation_timestamp, next(self._seq), pod),
         )
 
-    def _promote_ready(self) -> None:
+    def _promote_ready_locked(self) -> None:
         now = time.monotonic()
         for uid, (ready_at, pod) in list(self._backoff.items()):
             if ready_at <= now:
                 del self._backoff[uid]
-                self._push(pod)
+                self._push_locked(pod)
 

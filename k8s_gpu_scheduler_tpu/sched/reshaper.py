@@ -158,7 +158,11 @@ class SliceReshaper:
 
     def stop(self) -> None:
         self._stop.set()
-        thread = self._thread
+        # _thread is lock-guarded state (the worker nulls it on drained
+        # exit, _ensure_worker respawns under _mu) — snapshot it under the
+        # same lock; join() on the snapshot is then race-free either way.
+        with self._mu:
+            thread = self._thread
         if thread is not None:
             thread.join(timeout=5)
 

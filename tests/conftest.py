@@ -17,3 +17,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def recompile_guard():
+    """graftcheck recompile guard (analysis/recompile.py): track jitted
+    entry points, ``snapshot()`` after warmup, and the fixture FAILS the
+    test at teardown if any tracked jit cache grew afterwards — the
+    steady-state zero-retrace contract. Donation checks ride the same
+    module (``check_donation``)."""
+    from k8s_gpu_scheduler_tpu.analysis.recompile import RecompileGuard
+
+    guard = RecompileGuard()
+    yield guard
+    if guard.snapshotted:                # snapshot taken -> enforce
+        guard.assert_steady_state()

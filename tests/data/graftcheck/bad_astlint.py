@@ -1,0 +1,45 @@
+"""Seeded-bad fixture: AST-lint true positives.
+
+Reintroducing this file into the scanned tree must fail
+``python -m k8s_gpu_scheduler_tpu.analysis`` (and ``--fast``): it carries
+one violation per AST rule family — an unguarded access of lock-guarded
+state, a tracer cast + host time call inside a traced function, and a
+bare except. tests/test_analysis.py asserts each specific rule fires.
+"""
+import threading
+import time
+
+import jax
+
+
+class LeakyCounter:
+    """Writes `self._count` under `self._mu` in one method, reads it
+    lock-free in another — the lock-guard true positive."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._count = 0
+
+    def bump(self) -> None:
+        with self._mu:
+            self._count += 1
+
+    def peek(self) -> int:
+        return self._count          # unguarded read of guarded state
+
+
+def hot_step(x):
+    def body(carry, _):
+        t = time.time()             # host time inside the traced body
+        scale = float(carry.sum())  # tracer cast
+        return carry * scale + t, None
+
+    out, _ = jax.lax.scan(body, x, None, length=4)
+    return out
+
+
+def swallow_everything(fn):
+    try:
+        return fn()
+    except:                         # noqa: E722 — the bare-except fixture
+        return None

@@ -1,0 +1,32 @@
+"""Seeded-bad fixture: jaxpr-audit true positives in one toy function.
+
+The function is deliberately wasteful in exactly the ways the audit
+exists to catch — none of which are visible to the AST pass:
+
+- it closes over a 4 MiB weight matrix instead of taking it as an
+  argument (``captured-const``);
+- it upcasts a large bf16 activation to f32 mid-path (``f32-upcast``);
+- it runs a host callback inside the scan hot loop (``host-transfer``);
+- it computes a mean nothing consumes (``dead-output``).
+"""
+import jax
+import jax.numpy as jnp
+
+_W = jnp.ones((1024, 1024), jnp.float32)          # 4 MiB, captured by value
+
+
+def _bad_toy_step(x):
+    def body(carry, _):
+        jax.debug.callback(lambda v: None, carry[0, 0])   # host round trip
+        h = (carry @ _W.astype(jnp.bfloat16)).astype(jnp.float32)  # upcast
+        unused = h * 2.0                                   # dead output
+        return h.astype(jnp.bfloat16), None
+
+    out, _ = jax.lax.scan(body, x, None, length=2)
+    return out
+
+
+GRAFTCHECK_JAXPR_AUDIT = [
+    ("bad_toy_step", _bad_toy_step,
+     (jnp.zeros((512, 1024), jnp.bfloat16),)),
+]

@@ -1,0 +1,32 @@
+"""Seeded-bad fixture: a steady-state retrace.
+
+The jitted step takes the tick as a STATIC argument, so every dispatch
+after warmup is a fresh trace+compile — the classic quiet serving-
+throughput killer the recompile guard exists for.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _step(x, tick: int):
+    return x * tick
+
+
+def _build():
+    x = jnp.ones((8,))
+
+    def warmup():
+        _step(x, 0)
+
+    def make(t):
+        return lambda: _step(x, t)       # new static arg -> retrace
+
+    return warmup, [make(1), make(2), make(3)], {"step": _step}
+
+
+GRAFTCHECK_RECOMPILE_AUDIT = [
+    ("retracing_step", _build),
+]

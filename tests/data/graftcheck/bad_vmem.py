@@ -1,0 +1,14 @@
+"""Seeded-bad fixture: a declared kernel footprint over the VMEM budget.
+
+The ``GRAFTCHECK_VMEM_AUDIT`` hook is how out-of-tree kernels opt into
+the budgeter; this one declares the flash-decode working set for a
+block_k that streams 16k int8 rows of hd=512 per block with a GQA group
+of 32 — ~35 MiB of double-buffered blocks against the 16 MiB core.
+"""
+from k8s_gpu_scheduler_tpu.analysis.vmem import decode_attention_footprint
+
+GRAFTCHECK_VMEM_AUDIT = [
+    ("oversized_flash_decode",
+     decode_attention_footprint(s=32768, g=32, hd=512, block_k=16384,
+                                quant=True, bitmap=True)),
+]

@@ -1,0 +1,365 @@
+"""graftcheck (k8s_gpu_scheduler_tpu/analysis/) — the analyzer's own tests.
+
+Covers: suppression syntax, each AST rule's true-positive AND
+true-negative, the VMEM budgeter's accept/reject around the 16 MiB line,
+golden jaxpr-audit findings on the deliberately-bad toy function, the
+recompile guard + donation checks, the steady-state ContinuousBatcher
+regression (the serving engine's zero-retrace contract), and the CLI
+exit-code contract: 0 on the repaired tree, non-zero when any seeded
+bad-fixture file is reintroduced into the scanned paths.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from k8s_gpu_scheduler_tpu.analysis import (
+    VMEM_BYTES_PER_CORE, audit_vmem, decode_attention_footprint,
+    flash_attention_footprint, run_fast_passes, parse_suppressions,
+)
+from k8s_gpu_scheduler_tpu.analysis.astlint import lint_source
+from k8s_gpu_scheduler_tpu.analysis.vmem import KernelFootprint
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURES = os.path.join(HERE, "data", "graftcheck")
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# -- suppressions -------------------------------------------------------------
+
+class TestSuppressions:
+    def test_inline_and_bracketed(self):
+        sup = parse_suppressions(
+            "x = 1  # graftcheck: ignore[rule-a, rule-b]\n"
+            "y = 2  # graftcheck: ignore\n")
+        assert sup[1] == {"rule-a", "rule-b"}
+        assert "*" in sup[2]
+
+    def test_comment_only_line_covers_next(self):
+        sup = parse_suppressions(
+            "# graftcheck: ignore[host-sync] — rationale here\n"
+            "jax.device_get(x)\n")
+        assert "host-sync" in sup[1] and "host-sync" in sup[2]
+
+    def test_trailing_prose_before_marker(self):
+        sup = parse_suppressions(
+            "foo()  # compile — graftcheck: ignore[host-sync] (why)\n")
+        assert "host-sync" in sup[1]
+
+    def test_wrong_rule_does_not_suppress(self):
+        src = textwrap.dedent("""
+            import jax
+            def f(x):
+                def body(c, _):
+                    return c * float(c.sum()), None  # graftcheck: ignore[host-sync]
+                return jax.lax.scan(body, x, None, length=2)
+        """)
+        assert "tracer-cast" in rules_of(lint_source("<t>", src))
+
+
+# -- AST lint -----------------------------------------------------------------
+
+class TestAstLint:
+    def test_traced_rules_fire(self):
+        findings = lint_source(
+            os.path.join(FIXTURES, "bad_astlint.py"),
+            open(os.path.join(FIXTURES, "bad_astlint.py")).read())
+        rules = rules_of(findings)
+        assert {"lock-guard", "tracer-cast", "host-time-in-trace",
+                "bare-except"} <= rules
+
+    def test_numpy_in_trace(self):
+        src = textwrap.dedent("""
+            import numpy as np
+            import jax
+            @jax.jit
+            def f(x):
+                return x + np.square(x)
+        """)
+        assert "numpy-in-trace" in rules_of(lint_source("<t>", src))
+
+    def test_host_code_is_not_flagged(self):
+        """int()/float()/np/time OUTSIDE traced functions are host code."""
+        src = textwrap.dedent("""
+            import time
+            import numpy as np
+            def host(x):
+                t = time.time()
+                return float(np.mean(x)) + int(t)
+        """)
+        assert lint_source("<t>", src) == []
+
+    def test_transitive_traced_detection(self):
+        """A module-level fn CALLED from a jitted fn is traced too."""
+        src = textwrap.dedent("""
+            import jax
+            def helper(x):
+                return x * float(x.sum())
+            step = jax.jit(lambda x: helper(x))
+        """)
+        assert "tracer-cast" in rules_of(lint_source("<t>", src))
+
+    def test_lock_guard_true_negative(self):
+        """with-block accesses, *_locked helpers, __init__, Event attrs
+        and read-only deps must NOT be flagged."""
+        src = textwrap.dedent("""
+            import threading
+            class Good:
+                def __init__(self, dep):
+                    self._mu = threading.Lock()
+                    self._stop = threading.Event()
+                    self.dep = dep
+                    self._items = []
+                def put(self, x):
+                    with self._mu:
+                        self._items.append(self.dep.tag(x))
+                    self._stop.set()
+                def _drain_locked(self):
+                    out, self._items = self._items, []
+                    return out
+                def take(self):
+                    with self._mu:
+                        return self._drain_locked()
+        """)
+        assert lint_source("<t>", src) == []
+
+    def test_lock_guard_suppression(self):
+        src = textwrap.dedent("""
+            import threading
+            class C:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self._n = 0
+                def bump(self):
+                    with self._mu:
+                        self._n += 1
+                def peek(self):
+                    return self._n  # graftcheck: ignore[lock-guard] — GIL-atomic
+        """)
+        assert lint_source("<t>", src) == []
+
+    def test_host_sync_rule(self):
+        src = "def f(out):\n    out.block_until_ready()\n"
+        assert rules_of(lint_source("<t>", src)) == {"host-sync"}
+
+
+# -- VMEM budgeter ------------------------------------------------------------
+
+class TestVmem:
+    def test_presets_fit(self):
+        assert audit_vmem() == []
+
+    def test_accept_reject_around_the_line(self):
+        usable = int(VMEM_BYTES_PER_CORE * 0.9)
+        pad = usable - 4 * (usable // 4)         # land EXACTLY on the line
+        fits = KernelFootprint("fits", in_blocks=usable // 4,
+                               out_blocks=usable // 4, scratch=pad)
+        assert fits.total == usable and fits.check() == []
+        over = KernelFootprint("over", in_blocks=usable // 4,
+                               out_blocks=usable // 4, scratch=pad + 1)
+        bad = over.check()
+        assert len(bad) == 1 and bad[0].rule == "vmem-budget"
+
+    def test_double_buffer_accounting(self):
+        fp = decode_attention_footprint(s=8192, g=4, hd=128, block_k=256)
+        # k+v blocks dominate: 2 dtypes x 2 (double buffer) x 256 x 128 x 2B
+        assert fp.total >= 2 * 2 * 256 * 128 * 2
+        assert fp.check() == []
+
+    def test_oversized_kernel_rejected(self):
+        fp = decode_attention_footprint(s=32768, g=32, hd=512,
+                                        block_k=16384, quant=True)
+        assert fp.check() and fp.total > VMEM_BYTES_PER_CORE
+
+    def test_flash_backward_larger_than_forward(self):
+        fwd = flash_attention_footprint(256, 256, 128)
+        bwd = flash_attention_footprint(256, 256, 128, backward=True)
+        assert bwd.total > fwd.total - 2 ** 17  # same ballpark, bwd-heavy
+
+
+# -- jaxpr audit --------------------------------------------------------------
+
+class TestJaxprAudit:
+    def test_golden_findings_on_bad_toy(self):
+        from k8s_gpu_scheduler_tpu.analysis.jaxpr_audit import audit_callable
+
+        sys.path.insert(0, FIXTURES)
+        try:
+            import bad_jaxpr
+        finally:
+            sys.path.pop(0)
+        (name, fn, args), = bad_jaxpr.GRAFTCHECK_JAXPR_AUDIT
+        findings = audit_callable(fn, args, name)
+        rules = rules_of(findings)
+        assert {"captured-const", "f32-upcast", "host-transfer",
+                "dead-output"} <= rules
+        # the callback is inside the scan body -> ERROR severity
+        host = [f for f in findings if f.rule == "host-transfer"]
+        assert any(f.severity == "error" for f in host)
+
+    def test_clean_function_has_no_findings(self):
+        import jax.numpy as jnp
+
+        from k8s_gpu_scheduler_tpu.analysis.jaxpr_audit import audit_callable
+
+        findings = audit_callable(
+            lambda x, w: (x @ w).sum(), (jnp.ones((8, 8), jnp.bfloat16),
+                                         jnp.ones((8, 8), jnp.bfloat16)),
+            "clean")
+        assert findings == []
+
+    def test_entry_points_are_clean(self):
+        from k8s_gpu_scheduler_tpu.analysis import run_traced_passes
+
+        report = run_traced_passes(paths=[])
+        assert report.errors == [], "\n" + report.render()
+
+
+# -- recompile guard + donation ----------------------------------------------
+
+class TestRecompileGuard:
+    def test_detects_retrace(self):
+        import jax
+        import jax.numpy as jnp
+
+        from k8s_gpu_scheduler_tpu.analysis.recompile import (
+            assert_no_retrace,
+        )
+
+        f = jax.jit(lambda x: x + 1)
+        f(jnp.ones(3))
+        with pytest.raises(AssertionError, match="retrace"):
+            with assert_no_retrace({"f": f}):
+                f(jnp.ones(4))                    # new shape -> retrace
+
+    def test_steady_state_passes(self):
+        import jax
+        import jax.numpy as jnp
+
+        from k8s_gpu_scheduler_tpu.analysis.recompile import (
+            assert_no_retrace,
+        )
+
+        f = jax.jit(lambda x: x + 1)
+        f(jnp.ones(3))
+        with assert_no_retrace({"f": f}):
+            for _ in range(3):
+                f(jnp.ones(3))
+
+    def test_donation_held_and_broken(self):
+        import jax
+        import jax.numpy as jnp
+
+        from k8s_gpu_scheduler_tpu.analysis.recompile import check_donation
+
+        good = jax.jit(lambda x: x * 2, donate_argnums=(0,))
+        assert check_donation(good, jnp.ones((8, 8)), donated=(0,)) == []
+        # Shape-mismatched output -> XLA cannot alias; donation breaks.
+        bad = jax.jit(lambda x: x[0] + 1.0, donate_argnums=(0,))
+        findings = check_donation(bad, jnp.ones((8, 8)), donated=(0,))
+        assert findings and all(f.rule == "donation-broken"
+                                for f in findings)
+
+    def test_bad_recompile_fixture_caught(self):
+        from k8s_gpu_scheduler_tpu.analysis.recompile import (
+            audit_steady_state,
+        )
+
+        sys.path.insert(0, FIXTURES)
+        try:
+            import bad_recompile
+        finally:
+            sys.path.pop(0)
+        (name, build), = bad_recompile.GRAFTCHECK_RECOMPILE_AUDIT
+        findings = audit_steady_state(build, name)
+        assert rules_of(findings) == {"steady-state-retrace"}
+
+
+class TestBatcherSteadyState:
+    """The ISSUE's serving regression: warmed-up continuous batching must
+    decode indefinitely with ZERO jit cache misses and donated caches."""
+
+    def test_three_chunks_varying_bitmaps_zero_retrace(self, recompile_guard):
+        import jax
+
+        from k8s_gpu_scheduler_tpu.models.llama import (
+            LlamaConfig, init_params,
+        )
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = LlamaConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=48,
+                                chunk=2, prefill_bucket=8, kv_dtype="int8")
+        rng = np.random.default_rng(0)
+        # Warmup: covers the prefill rung and the decode chunk program.
+        eng.submit(rng.integers(0, cfg.vocab, 5), max_new=3)
+        eng.run()
+        # A long-running request pins a slot so the engine never fully
+        # drains mid-test (a drain epoch-rolls, which REPLACES the bitmap
+        # instead of donating it — by design). One step admits it AND
+        # performs the post-drain epoch roll before the measured waves.
+        eng.submit(rng.integers(0, cfg.vocab, 5), max_new=9)
+        eng.step()
+
+        recompile_guard.track("decode", eng._decode)
+        recompile_guard.track("prefill", eng._prefill)
+        recompile_guard.snapshot()
+        # 3 decode chunks with different prompt lengths => different fill
+        # bitmaps/cursors each wave; by design ONE compiled program serves
+        # them all.
+        for plen in (4, 6, 8):
+            eng.submit(rng.integers(0, cfg.vocab, plen), max_new=2)
+            k_before = eng._k
+            bitmap_before = eng._bitmap
+            eng.step()
+            # Donation held: the pre-dispatch cache and bitmap buffers
+            # were consumed by the donating dispatch, not copied.
+            assert k_before.is_deleted(), "kv cache was not donated"
+            assert bitmap_before.is_deleted(), "bitmap was not donated"
+        assert recompile_guard.misses_since() == {"decode": 0, "prefill": 0}
+        eng.run()                                  # drain the long request
+        # fixture teardown re-asserts steady state
+
+
+# -- CLI contract -------------------------------------------------------------
+
+def run_cli(*extra, fast=True):
+    cmd = [sys.executable, "-m", "k8s_gpu_scheduler_tpu.analysis"]
+    if fast:
+        cmd.append("--fast")
+    cmd += list(extra)
+    return subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                          timeout=300)
+
+
+class TestCli:
+    def test_repaired_tree_exits_zero(self):
+        proc = run_cli()
+        assert proc.returncode == 0, proc.stderr
+
+    def test_reintroduced_fast_fixtures_fail(self):
+        for fixture in ("bad_astlint.py", "bad_vmem.py"):
+            proc = run_cli(os.path.join(FIXTURES, fixture))
+            assert proc.returncode == 1, (fixture, proc.stderr)
+            assert ": [" in proc.stderr       # file:line: [rule] rendering
+
+    def test_full_cli_catches_all_four_fixture_families(self):
+        """The acceptance criterion end-to-end: the DEFAULT four-pass CLI
+        exits non-zero with file:line findings when the seeded bad
+        fixtures are in the scanned paths (one subprocess run for all
+        four — the traced passes dominate its ~15 s)."""
+        proc = run_cli(FIXTURES, "--json", fast=False)
+        assert proc.returncode == 1, proc.stderr
+        import json as _json
+
+        summary = _json.loads(proc.stdout.strip().splitlines()[-1])
+        assert {"lock-guard", "vmem-budget", "captured-const",
+                "steady-state-retrace"} <= set(summary["rules"])

@@ -1,0 +1,25 @@
+"""Tier-1 gate: the tree must stay graftcheck-clean.
+
+Runs the FAST passes (AST lint + VMEM budgeter — no tracing, ~2 s) over
+the package exactly as ``make lint`` does, and fails with the rendered
+``file:line: [rule] message`` list if anything regressed. The traced
+passes (jaxpr audit, recompile guard) have their own tests in
+tests/test_analysis.py; the full four-pass run is
+``python -m k8s_gpu_scheduler_tpu.analysis``.
+
+Suppression policy: ``# graftcheck: ignore[rule]`` with a rationale in
+the surrounding comment (see README "graftcheck").
+"""
+import os
+
+import k8s_gpu_scheduler_tpu
+from k8s_gpu_scheduler_tpu.analysis import run_fast_passes
+
+PKG = os.path.dirname(os.path.abspath(k8s_gpu_scheduler_tpu.__file__))
+
+
+def test_tree_is_graftcheck_clean():
+    report = run_fast_passes([PKG])
+    assert not report.findings, "\n" + report.render(
+        header="graftcheck regressions (fix them or suppress WITH a "
+               "rationale — see README):")

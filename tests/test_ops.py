@@ -21,6 +21,7 @@ from k8s_gpu_scheduler_tpu.ops import (
     ulysses_attention,
 )
 from k8s_gpu_scheduler_tpu.parallel import MeshSpec, make_mesh
+from k8s_gpu_scheduler_tpu.parallel.sharding import shard_map
 
 
 def qkv(B=2, T=32, H=8, Hkv=4, d=16, dtype=jnp.float32):
@@ -34,7 +35,7 @@ def qkv(B=2, T=32, H=8, Hkv=4, d=16, dtype=jnp.float32):
 def sharded(impl, mesh):
     spec = P("dp", "sp", "tp", None)
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             partial(impl, axis_name="sp", causal=True),
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -66,7 +67,7 @@ class TestSequenceParallelAttention:
         ref = dense_attention(q, k, v, causal=False)
         spec = P("dp", "sp", "tp", None)
         out = jax.jit(
-            jax.shard_map(
+            shard_map(
                 partial(ring_attention, axis_name="sp", causal=False),
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                 check_vma=False,
@@ -215,7 +216,7 @@ class TestFlashAttention:
         mesh = make_mesh(MeshSpec({"dp": 2, "fsdp": 1, "sp": 1, "tp": 4}))
         q, k, v = qkv(B=2, T=128, H=8, Hkv=4, d=32)
         spec = P(("dp", "fsdp"), None, "tp", None)
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             lambda q, k, v: flash_attention_diff(q, k, v, True),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
