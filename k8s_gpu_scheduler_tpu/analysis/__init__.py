@@ -29,7 +29,7 @@ from .findings import ALL_RULES, Finding, Report, parse_suppressions
 from .astlint import lint_source, run_astlint
 from .vmem import (
     VMEM_BYTES_PER_CORE, audit_vmem, decode_attention_footprint,
-    flash_attention_footprint,
+    flash_attention_footprint, paged_decode_attention_footprint,
 )
 
 __all__ = [
@@ -43,6 +43,7 @@ __all__ = [
     "audit_vmem",
     "decode_attention_footprint",
     "flash_attention_footprint",
+    "paged_decode_attention_footprint",
     "run_fast_passes",
     "run_traced_passes",
 ]

@@ -76,6 +76,26 @@ def jaxpr_entrypoints() -> List[Tuple[str, Callable, tuple]]:
          np.int32(4), eng._rope_pos, eng._last,
          np.asarray([True, False]), np.int32(2))))
 
+    # Paged ContinuousBatcher dispatches (the block-table/page-pool
+    # layout; fused decode so the table-indirected kernel traces too).
+    import dataclasses
+
+    peng = serving.ContinuousBatcher(
+        params, dataclasses.replace(cfg, decode_attn="fused"), n_slots=2,
+        max_len=32, chunk=2, prefill_bucket=4, kv_dtype="int8",
+        kv_layout="paged", page_size=8)
+    pids = np.ones((2, 1), np.int32)                 # one 8-row page each
+    tokens8 = np.zeros((2, 8), np.int32)             # tb page-rounded to 8
+    entries.append((
+        "batcher_prefill_paged", peng._prefill,
+        (params, peng._k, peng._v, peng._ks, peng._vs, peng._lens,
+         peng._last, slots, pids, tokens8, lens, np.int32(1))))
+    entries.append((
+        "batcher_decode_paged", peng._decode,
+        (params, peng._k, peng._v, peng._ks, peng._vs,
+         peng._table_np.copy(), peng._lens, peng._last,
+         np.asarray([True, False]), np.int32(2))))
+
     # Pipeline train step (pp >= 2 needs >= 2 local devices; conftest/CLI
     # request an 8-device CPU mesh before jax initializes).
     if len(jax.devices()) >= 2:
@@ -102,6 +122,16 @@ def jaxpr_entrypoints() -> List[Tuple[str, Callable, tuple]]:
                     lambda q, k, v, n: dense_decode_reference(
                         q, k, v, lengths=n),
                     (q, kc, kc, lengths)))
+
+    # Paged decode attention: same contract through a page pool + block
+    # table (the table is a scalar-prefetch operand of the kernel).
+    from ..ops.decode_attention import paged_decode_attention
+
+    pool = jnp.zeros((17, 8, 8, 8), jnp.bfloat16)    # 16 pages + null
+    table = jnp.tile(jnp.arange(1, 9, dtype=jnp.int32)[None], (2, 1))
+    entries.append(("paged_decode_attention",
+                    partial(paged_decode_attention, interpret=True),
+                    (q, pool, pool, table, lengths)))
     return entries
 
 
@@ -117,6 +147,39 @@ def _batcher_scenario() -> tuple:
     cfg, params = _tiny()
     eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=32, chunk=2,
                             prefill_bucket=8, kv_dtype="int8")
+    rng = np.random.default_rng(0)
+
+    def warmup():
+        eng.submit(rng.integers(0, cfg.vocab, 5), max_new=3)
+        eng.run()
+
+    def wave(plen: int):
+        def go():
+            eng.submit(rng.integers(0, cfg.vocab, plen), max_new=3)
+            eng.submit(rng.integers(0, cfg.vocab, plen - 1), max_new=2)
+            eng.run()
+        return go
+
+    steady = [wave(4), wave(6), wave(8)]
+    return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
+
+
+def _paged_batcher_scenario() -> tuple:
+    """Paged analog of _batcher_scenario: steady-state decode across waves
+    whose BLOCK TABLES differ (fresh admissions land on recycled pages in
+    a different physical order every wave) must still be one compiled
+    program — the table varies in content, never in shape, and the pool +
+    table ride the donation chain."""
+    import dataclasses
+
+    from ..models.serving import ContinuousBatcher
+
+    cfg, params = _tiny()
+    eng = ContinuousBatcher(params, dataclasses.replace(cfg,
+                                                        decode_attn="fused"),
+                            n_slots=2, max_len=32, chunk=2,
+                            prefill_bucket=8, kv_dtype="int8",
+                            kv_layout="paged", page_size=8)
     rng = np.random.default_rng(0)
 
     def warmup():
@@ -156,6 +219,7 @@ def _generate_scenario() -> tuple:
 def recompile_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
     return [
         ("batcher_steady_decode", _batcher_scenario),
+        ("batcher_steady_decode_paged", _paged_batcher_scenario),
         ("generate_steady_state", _generate_scenario),
     ]
 
@@ -182,6 +246,22 @@ def donation_audit() -> List:
             np.asarray([True, True]), np.int32(1))
     findings += check_donation(eng._decode, *args, donated=(1, 2, 3, 4, 5),
                                name="batcher_decode")
+
+    # Paged decode: the page pool, its scale planes AND the block table
+    # must all be consumed — the table is donated-through unchanged in
+    # steady state, which still has to alias (no silent copy per chunk).
+    import jax.numpy as jnp
+
+    peng = ContinuousBatcher(params, cfg, n_slots=2, max_len=32, chunk=2,
+                             prefill_bucket=4, kv_dtype="int8",
+                             kv_layout="paged", page_size=8)
+    pargs = (params, peng._k, peng._v, peng._ks, peng._vs,
+             jnp.asarray(peng._table_np), jnp.zeros((2,), jnp.int32),
+             jnp.zeros((2,), jnp.int32), np.asarray([True, True]),
+             np.int32(1))
+    findings += check_donation(peng._decode, *pargs,
+                               donated=(1, 2, 3, 4, 5),
+                               name="batcher_decode_paged")
 
     opt = optax.adamw(1e-3)
     state = jax.jit(opt.init)(params)

@@ -102,6 +102,39 @@ def decode_attention_footprint(
     )
 
 
+def paged_decode_attention_footprint(
+    page_size: int, g: int, hd: int, n_blocks: int, batch: int = 8,
+    kv_dtype: str = "bfloat16", quant: bool = False,
+    q_dtype: str = "bfloat16",
+) -> KernelFootprint:
+    """Working set of ops/decode_attention._paged_kernel for one grid
+    program: the page IS the kv block, so the VMEM picture matches the
+    contiguous kernel at block_k == page_size (q block, double-buffered
+    k/v page blocks, int8 scale planes in quant mode, three partial
+    outputs, (acc, m, l) scratch) — no bitmap operand (the per-slot
+    length bound subsumes it in the paged design) — PLUS the scalar-
+    prefetch working set: ``lengths`` [B] and the block table
+    [B, n_blocks] int32, resident for the whole kernel (SMEM-side, but
+    counted against the same budget conservatively)."""
+    kv_d = "int8" if quant else kv_dtype
+    in_blocks = _nbytes((1, g, hd), q_dtype) \
+        + 2 * _nbytes((1, page_size, 1, hd), kv_d)
+    if quant:
+        in_blocks += 2 * _nbytes((1, page_size, 1, 1), "float32")
+    out_blocks = _nbytes((1, 1, g, hd), "float32") \
+        + 2 * _nbytes((1, 1, g, _LANES), "float32")
+    scratch = _nbytes((g, hd), "float32") + 2 * _nbytes((g, _LANES), "float32")
+    scratch += _nbytes((batch,), "int32") \
+        + _nbytes((batch, n_blocks), "int32")        # scalar prefetch
+    return KernelFootprint(
+        name=f"paged_decode(ps={page_size}, n_blocks={n_blocks}, g={g}, "
+             f"hd={hd}, kv={'int8' if quant else kv_dtype})",
+        in_blocks=in_blocks, out_blocks=out_blocks, scratch=scratch,
+        notes=f"page_size={page_size}, double-buffered page blocks + "
+              f"[B,{n_blocks}] block table",
+    )
+
+
 def flash_attention_footprint(
     block_q: int, block_k: int, d: int, dtype: str = "bfloat16",
     with_lse: bool = True, backward: bool = False,
@@ -160,9 +193,13 @@ def _presets() -> List[Tuple[str, "object", Dict]]:
 def audit_vmem(budget: int = VMEM_BYTES_PER_CORE) -> List[Finding]:
     """Block-divisibility + VMEM-budget audit of every kernel the presets
     can reach: flash-decode at each preset's serving cache lengths (bf16
-    and int8-KV, with the batcher's bitmap), training flash fwd+bwd at
-    each preset's max_seq."""
-    from ..ops.decode_attention import decode_plan
+    and int8-KV, with the batcher's bitmap), the PAGED decode plan at the
+    default page size (page-size divisibility + page-block working set +
+    block-table scalar footprint), training flash fwd+bwd at each
+    preset's max_seq."""
+    from ..ops.decode_attention import (
+        DEFAULT_PAGE_SIZE, decode_plan, paged_plan,
+    )
     from ..ops.flash_attention import _shrink_to_divisor
 
     findings: List[Finding] = []
@@ -183,6 +220,23 @@ def audit_vmem(budget: int = VMEM_BYTES_PER_CORE) -> List[Finding]:
                 fp = decode_attention_footprint(
                     s, g, cfg.head_dim, block_k, quant=quant, bitmap=True)
                 findings.extend(fp.check(budget, anchor=anchor))
+            # Paged plan at the serving default page size: every preset a
+            # paged ContinuousBatcher could serve must both divide into
+            # pages AND have a legal kernel plan, or admission at that
+            # config silently loses the fused path (a perf cliff the
+            # contiguous fallback comment documents).
+            ps = DEFAULT_PAGE_SIZE
+            if s % ps or paged_plan(s // ps, ps) is None:
+                findings.append(Finding(
+                    "block-divisibility", anchor, 0,
+                    f"preset {name}: cache length S={s} has no legal "
+                    f"paged plan at page_size={ps} — paged fused decode "
+                    f"would fall back to the dense gather path"))
+            else:
+                for quant in (False, True):
+                    fp = paged_decode_attention_footprint(
+                        ps, g, cfg.head_dim, s // ps, quant=quant)
+                    findings.extend(fp.check(budget, anchor=anchor))
         # Training flash attention at max_seq (forward defaults 256/512;
         # backward shrinks to <=256 divisors — mirror _resolve/_bwd).
         t = cfg.max_seq

@@ -17,6 +17,7 @@ from .serving import (
     generate_speculative,
     init_cache, make_server_step, make_speculative_server_step,
 )
+from .paging import PageAllocator
 from .pipeline import make_pp_train_step, pp_loss_fn
 
 __all__ = [
@@ -36,6 +37,7 @@ __all__ = [
     "make_server_step",
     "make_speculative_server_step",
     "ContinuousBatcher",
+    "PageAllocator",
     "make_pp_train_step",
     "pp_loss_fn",
 ]

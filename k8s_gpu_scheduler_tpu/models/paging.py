@@ -1,0 +1,117 @@
+"""Host-side KV page allocator for the paged serving cache.
+
+The paged ContinuousBatcher (models/serving.py) replaces the shared
+scalar cursor with a pool of fixed-size KV pages and a per-slot block
+table: admission needs FREE PAGES, not a contiguous window, so a prompt
+admits the moment enough requests have finished — no backward-write
+trick, no epoch roll, no all-slots-drained idle boundary. This module is
+the allocator half of that design: a plain LIFO free list (recently
+freed pages are re-written soonest — friendliest to whatever HBM pages
+are still warm) with watermark/churn metrics the bench and the serving
+entrypoint publish.
+
+Page 0 is RESERVED as the null/scratch page: device-side writes for
+inactive slots and the over-provisioned tail of a padded prefill scatter
+are redirected there (a fixed, never-handed-out target keeps those
+writes branch-free on device), and zeroed block-table rows point at it.
+Its contents are garbage by design and never attended — every read of it
+is masked by the length bound.
+
+Allocation is all-or-nothing and WORST-CASE at admission: the batcher
+reserves ceil((prompt + decode rows)/page_size) pages up front, so a
+request in flight can never stall mid-decode waiting for a page another
+stuck request holds (no allocation deadlock), at the cost of eos
+early-stop releasing its unused tail only at finish. Free is immediate
+and exact — the fragmentation the contiguous cursor design pays (stale
+epochs, bucket-ladder re-dispatch, roll stalls) simply has no analog
+here.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+NULL_PAGE = 0
+
+
+class PageAllocator:
+    """Fixed-size page pool bookkeeping. ``n_pages`` counts the WHOLE pool
+    including the reserved null page, so a pool of n_pages has
+    ``n_pages - 1`` usable pages."""
+
+    def __init__(self, n_pages: int) -> None:
+        if n_pages < 2:
+            raise ValueError(
+                f"need >= 2 pages (one is the reserved null page), got "
+                f"{n_pages}")
+        self.n_pages = n_pages
+        # LIFO: freed pages are reused first.
+        self._free: List[int] = list(range(n_pages - 1, NULL_PAGE, -1))
+        self._held: set = set()              # pages currently allocated
+        self._watermark = 0
+        self._allocs = 0
+        self._frees = 0
+        self._denied = 0
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return len(self._held)
+
+    def alloc(self, n: int,
+              count_denied: bool = True) -> Optional[List[int]]:
+        """n pages, or None when fewer than n are free (all-or-nothing —
+        a partial grant could deadlock two admissions against each
+        other). ``count_denied=False`` suppresses the denial counter for
+        RETRIES of an already-counted request — the batcher re-attempts
+        its blocked queue head every decode step, and counting each
+        retry would report a thousand denials for one waiting request."""
+        if n < 0:
+            raise ValueError(f"negative page count {n}")
+        if n > len(self._free):
+            if count_denied:
+                self._denied += 1
+            return None
+        pages, self._free = self._free[len(self._free) - n:], \
+            self._free[:len(self._free) - n]
+        pages.reverse()                      # LIFO pop order, stable ids
+        self._held.update(pages)
+        self._watermark = max(self._watermark, len(self._held))
+        self._allocs += n
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        """Return pages to the pool. Per-page validated BEFORE any state
+        mutates: a double free (or freeing a page this allocator never
+        handed out) would put the same id on the free list twice, handing
+        one physical page to two future requests — silent KV
+        cross-contamination, the worst possible failure mode."""
+        for p in pages:
+            if p == NULL_PAGE:
+                raise ValueError("cannot free the reserved null page")
+            if p not in self._held:
+                raise RuntimeError(
+                    f"double free (or foreign page): page {p} is not "
+                    f"currently allocated")
+        for p in pages:
+            self._held.discard(p)
+            self._free.append(p)
+        self._frees += len(pages)
+
+    def metrics(self) -> Dict[str, float]:
+        """Allocator state for the bench/Observation publishers. The
+        utilization is instantaneous (pages now held / usable pool);
+        the watermark is the high-water mark since construction."""
+        usable = self.n_pages - 1
+        return {
+            "pages_total": float(usable),
+            "pages_free": float(len(self._free)),
+            "pages_in_use": float(len(self._held)),
+            "pages_watermark": float(self._watermark),
+            "page_allocs": float(self._allocs),
+            "page_frees": float(self._frees),
+            "page_denied": float(self._denied),
+            "page_utilization": (len(self._held) / usable) if usable else 0.0,
+        }

@@ -26,8 +26,12 @@ automatic fallback to dense wherever the kernel doesn't apply.
 On top of the static path: ``ContinuousBatcher`` (slot admission between
 decode chunks, batched one-dispatch prefill with a bucket ladder for long
 prompts, deferred readbacks, EOS early-stop, temperature/top-k sampling,
-int8 weights via ops/quant.py) and ``generate_speculative`` (prompt-lookup
-speculation, draft-model-free).
+int8 weights via ops/quant.py) with TWO cache layouts — the contiguous
+shared-cursor cache and a vLLM-style PAGED cache (``kv_layout="paged"``:
+fixed-size page pool + per-slot block tables + models/paging.py's host
+allocator; no admission contiguity constraint, no epoch roll, block
+tables ride the fused kernel as a scalar-prefetch operand) — and
+``generate_speculative`` (prompt-lookup speculation, draft-model-free).
 
 The reference has no serving engine at all (it schedules inference pods,
 SURVEY.md §0); this is the workload side of BASELINE config 5
@@ -46,11 +50,14 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.decode_attention import (
-    decode_plan, dense_decode_reference, flash_decode_attention,
+    DEFAULT_PAGE_SIZE, decode_plan, dense_decode_reference,
+    flash_decode_attention, gather_paged_kv, paged_decode_attention,
+    paged_plan,
 )
 from ..ops.layers import apply_rope, rms_norm, rope_freqs
 from ..ops.quant import qdot
 from .llama import LlamaConfig, _constrain, mlp_sublayer
+from .paging import NULL_PAGE, PageAllocator
 
 _NEG_INF = -1e30
 
@@ -541,22 +548,220 @@ def _prefill_multi_fn(params, cfg: LlamaConfig, mesh: Optional[Mesh],
     return k, v, k_s, v_s, bitmap, rope_pos, last, jnp.stack(firsts)
 
 
+# -- paged KV cache -----------------------------------------------------------
+#
+# The contiguous engine above reconciles per-slot positions against ONE
+# shared cursor — which costs a hard contiguity constraint (admission needs
+# a whole window below S) and an epoch roll that idles the entire batch
+# every ~S decode steps. The paged engine removes both, vLLM-style: K/V
+# live in a pool of fixed-size pages [L, n_pages, ps, Hkv, hd]; each slot
+# names its pages through a [n_slots, n_blocks] block table; logical row r
+# of a slot lives at (table[slot, r // ps], r % ps). Admission takes pages
+# wherever they are free (PageAllocator — worst-case reservation, so no
+# mid-decode stalls), finished requests free them immediately, and the
+# per-slot length vector replaces cursor+bitmap+rope_pos in one: lens IS
+# the rope position, the attention bound, and the write address. The block
+# table rides into the fused kernel as a scalar-prefetch operand
+# (ops.paged_decode_attention), so decode keeps the O(pos) block-streamed
+# reads; the pool and table are donated every dispatch, preserving the
+# recompile guard's zero-retrace/zero-copy steady state (tables vary in
+# CONTENT across chunks, never in shape).
+#
+# The decode write is a B-row scatter (each slot targets its own page/
+# offset) instead of the cursor's single dynamic_update_slice — the price
+# of per-slot positions; it is B rows of [Hkv, hd], not the full-cache
+# masked rewrite that motivated the cursor design. Inactive slots redirect
+# their write to the reserved null page (paging.NULL_PAGE), whose contents
+# are garbage by contract and only ever read under a mask.
+
+
+def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
+                           page_size: int, k, v, table, lens, last, active,
+                           seed, temperature: float = 0.0, top_k: int = 0,
+                           k_s=None, v_s=None):
+    """Advance every active slot ``chunk`` tokens against the paged pool
+    k/v [L, n_pages, ps, Hkv, hd] with block table [B, n_blocks] and
+    per-slot filled lengths [B]. The table is read-only here (pages are
+    reserved at admission) and returned as-is so the jit donation aliases
+    it through; ``lens`` advances per active slot per tick and is the rope
+    position, the write address, and the attention length bound at once —
+    the cursor/bitmap/rope_pos triple of the contiguous engine collapsed
+    into one vector."""
+    quant = k_s is not None
+    B = last.shape[0]
+    n_blocks = table.shape[1]
+    S = n_blocks * page_size
+    fused = (getattr(cfg, "decode_attn", "dense") == "fused"
+             and cfg.n_heads % cfg.n_kv_heads == 0
+             and paged_plan(n_blocks, page_size) is not None)
+    angles_full = rope_freqs(cfg.head_dim, S, cfg.rope_theta)
+    row_ids = jnp.arange(B)
+    base_key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+    active_i = jnp.asarray(active)
+
+    def one_token(carry, tick):
+        k, v, k_s, v_s, lens, last = carry
+        x = params["embed"][last[:, None]].astype(cfg.dtype)   # [B, 1, D]
+        angles = angles_full[lens][:, None, :]                 # [B, 1, hd/2]
+        # Physical address of the row being written: active slots append at
+        # logical row `lens`; inactive slots are redirected to the null
+        # page (their stale lens may even sit at capacity — the table
+        # gather clamps, the write lands in garbage-by-contract rows).
+        pg = table[row_ids, jnp.minimum(lens // page_size, n_blocks - 1)]
+        off = lens % page_size
+        pg_w = jnp.where(active_i, pg, NULL_PAGE)
+        off_w = jnp.where(active_i, off, 0)
+
+        def block(x, layer):
+            blk, k_pg, v_pg, ks_p, vs_p = layer      # [n_pages, ps, Hkv, hd]
+            h = rms_norm(x, blk["attn_norm"])
+            q = qdot(h, blk["wq"]).reshape(B, 1, cfg.n_heads, cfg.head_dim)
+            kk = qdot(h, blk["wk"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
+            vv = qdot(h, blk["wv"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
+            q, kk = apply_rope(q, angles), apply_rope(kk, angles)
+            if quant:
+                kq, ksn = _kv_quant(kk)
+                vq, vsn = _kv_quant(vv)
+                k_pg = k_pg.at[pg_w, off_w].set(kq[:, 0])
+                v_pg = v_pg.at[pg_w, off_w].set(vq[:, 0])
+                ks_p = ks_p.at[pg_w, off_w].set(ksn[:, 0])
+                vs_p = vs_p.at[pg_w, off_w].set(vsn[:, 0])
+            else:
+                k_pg = k_pg.at[pg_w, off_w].set(kk[:, 0])
+                v_pg = v_pg.at[pg_w, off_w].set(vv[:, 0])
+            scales = dict(k_scale=ks_p, v_scale=vs_p) if quant else {}
+            if fused:
+                # Table-indirected streamed kernel: logical blocks past
+                # ceil((lens+1)/ps) are skipped, so the step costs O(pos)
+                # pool traffic regardless of where the pages physically
+                # sit.
+                attn = paged_decode_attention(
+                    q[:, 0], k_pg, v_pg, table, lens + 1, **scales)
+            else:
+                # Dense fallback: materialize the sequence-contiguous view
+                # through the table and reuse the grouped reference — the
+                # same O(allocated S) read the contiguous dense path pays.
+                dsc = {}
+                if quant:
+                    dsc = dict(k_scale=gather_paged_kv(ks_p, table),
+                               v_scale=gather_paged_kv(vs_p, table))
+                attn = dense_decode_reference(
+                    q[:, 0], gather_paged_kv(k_pg, table),
+                    gather_paged_kv(v_pg, table), lengths=lens + 1, **dsc)
+            x = x + qdot(attn.reshape(B, 1, cfg.n_heads * cfg.head_dim),
+                         blk["wo"])
+            x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
+            return x, (k_pg, v_pg, ks_p, vs_p)
+
+        x, (k, v, k_s, v_s) = jax.lax.scan(
+            block, x, (params["blocks"], k, v, k_s, v_s))
+        x = rms_norm(x, params["final_norm"])
+        logits = qdot(x[:, 0], params["lm_head"]).astype(jnp.float32)
+        nxt = _sample_tokens(
+            logits, jax.random.fold_in(base_key, tick), temperature, top_k
+        ).astype(last.dtype)
+        emitted = jnp.where(active_i, nxt, -1)
+        last = jnp.where(active_i, nxt, last)
+        lens = lens + active_i.astype(lens.dtype)
+        return (k, v, k_s, v_s, lens, last), emitted
+
+    (k, v, k_s, v_s, lens, last), toks = jax.lax.scan(
+        one_token, (k, v, k_s, v_s, lens, last), jnp.arange(chunk))
+    return k, v, k_s, v_s, table, lens, last, jnp.swapaxes(toks, 0, 1)
+
+
+def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
+                            k, v, lens, last, slots, page_ids, tokens,
+                            real_lens, seed, temperature: float = 0.0,
+                            top_k: int = 0, k_s=None, v_s=None):
+    """Prefill M freed slots from right-padded prompts [M, tb] in ONE
+    dispatch, paged edition: the batched mini cache computes every
+    prompt's K/V exactly as the contiguous path, then ONE page-granular
+    scatter writes the [M, tb] rows into the pool at ``page_ids``
+    [M, tb/ps] — each row of which the host fills with the entry's
+    reserved pages, padding the beyond-need tail with the null page
+    (bucket tb can overshoot the rows the request will ever own). Pad
+    entries repeat a REAL entry, so duplicate page ids carry identical
+    values and the scatter stays idempotent, mirroring the contiguous
+    path's padding contract. Only ``real_len`` logical rows become
+    attendable (lens is set to real_len); the garbage the padded tail
+    writes inside the last page sits above lens until the slot's own
+    decode steps overwrite it."""
+    quant = k_s is not None
+    B = last.shape[0]
+    M, tb = tokens.shape
+    npg = page_ids.shape[1]
+    mini = {
+        "k": jnp.zeros((cfg.n_layers, M, tb, cfg.n_kv_heads, cfg.head_dim),
+                       cfg.dtype),
+        "v": jnp.zeros((cfg.n_layers, M, tb, cfg.n_kv_heads, cfg.head_dim),
+                       cfg.dtype),
+        "len": jnp.zeros((), jnp.int32),
+    }
+    logits, mini = forward_with_cache(params, tokens, cfg, mini, mesh=None)
+
+    def page_blocks(a):
+        # [L, M, tb, Hkv, x] -> [L, M*npg, ps, Hkv, x] page-granular blocks
+        return a.reshape(a.shape[0], M * npg, page_size, *a.shape[3:])
+
+    ids = page_ids.reshape(M * npg)
+    if quant:
+        mkq, mks = _kv_quant(mini["k"])
+        mvq, mvs = _kv_quant(mini["v"])
+        k = k.at[:, ids].set(page_blocks(mkq))
+        v = v.at[:, ids].set(page_blocks(mvq))
+        k_s = k_s.at[:, ids].set(page_blocks(mks))
+        v_s = v_s.at[:, ids].set(page_blocks(mvs))
+    else:
+        k = k.at[:, ids].set(page_blocks(mini["k"]))
+        v = v.at[:, ids].set(page_blocks(mini["v"]))
+    row_ids = jnp.arange(B)
+    base_key = jax.random.fold_in(jax.random.PRNGKey(1), seed)
+    firsts = []
+    for i in range(M):                               # static unroll
+        slot, real_len = slots[i], real_lens[i]
+        is_slot = row_ids == slot
+        # Key by SLOT (see _prefill_multi_fn): pad rows duplicate a real
+        # entry and must re-draw the same token.
+        first = _sample_tokens(
+            logits[i, real_len - 1], jax.random.fold_in(base_key, slot),
+            temperature, top_k,
+        ).astype(last.dtype)
+        lens = jnp.where(is_slot, real_len, lens)
+        last = jnp.where(is_slot, first, last)
+        firsts.append(first)
+    return k, v, k_s, v_s, lens, last, jnp.stack(firsts)
+
+
 class ContinuousBatcher:
     """Host-side orchestrator: admit requests into free cache slots between
     decode chunks; finished slots free immediately for the next waiting
     request. The chunk is the continuous-batching granularity (chunked so
     the ~100 ms axon host↔device round trip amortizes). BASELINE config
-    5's serving engine."""
+    5's serving engine.
+
+    ``kv_layout="paged"`` swaps the shared-cursor contiguous cache for the
+    paged pool + block table (see the section comment above): admission
+    needs free PAGES instead of a contiguous cursor window, finished
+    requests free theirs immediately, and there is no epoch roll."""
 
     def __init__(self, params, cfg: LlamaConfig, n_slots: int = 8,
                  max_len: Optional[int] = None, chunk: int = 8,
                  prefill_bucket: int = 128, mesh: Optional[Mesh] = None,
                  eos_id: Optional[int] = None, temperature: float = 0.0,
-                 top_k: int = 0, kv_dtype: Optional[str] = None):
+                 top_k: int = 0, kv_dtype: Optional[str] = None,
+                 kv_layout: str = "contiguous",
+                 page_size: int = DEFAULT_PAGE_SIZE,
+                 n_pages: Optional[int] = None):
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
         self.chunk = chunk
+        if kv_layout not in ("contiguous", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'contiguous' or 'paged', got "
+                f"{kv_layout!r}")
+        self.layout = kv_layout
         # kv_dtype: None keeps the cache in cfg.dtype; "int8" stores K/V
         # int8 with per-token-per-head scale planes (_kv_quant) — halves
         # cache HBM traffic AND capacity cost (2x slots at fixed HBM).
@@ -585,20 +790,63 @@ class ContinuousBatcher:
         self._dispatch_no = 0
         self._eos_scanned: Dict[int, int] = {}       # req id -> tokens scanned
         self.S = min(max_len or cfg.max_seq, cfg.max_seq)
-        if kv_dtype == "int8":
-            shape = (cfg.n_layers, n_slots, self.S, cfg.n_kv_heads,
-                     cfg.head_dim)
-            self._k = jnp.zeros(shape, jnp.int8)
-            self._v = jnp.zeros(shape, jnp.int8)
-            self._ks = jnp.zeros(shape[:-1] + (1,), jnp.float32)
-            self._vs = jnp.zeros(shape[:-1] + (1,), jnp.float32)
+        if kv_layout == "paged":
+            if mesh is not None:
+                # pallas_call does not partition under GSPMD and the pool
+                # is not a per-slot activation the CACHE_SPEC rules cover;
+                # sharded serving keeps the contiguous layout for now
+                # (ROADMAP: fused decode under GSPMD).
+                raise NotImplementedError(
+                    "kv_layout='paged' requires unsharded serving "
+                    "(mesh=None)")
+            if self.S % page_size:
+                raise ValueError(
+                    f"cache capacity {self.S} not divisible by page_size "
+                    f"{page_size}")
+            self.page_size = page_size
+            self.n_blocks = self.S // page_size
+            # Default pool: the same row capacity the contiguous cache
+            # would allocate (n_slots full windows), plus the reserved
+            # null page. Smaller pools oversubscribe deliberately —
+            # admission then waits on free pages, not on a cursor window.
+            n_pages = n_pages or (1 + n_slots * self.n_blocks)
+            self._alloc = PageAllocator(n_pages)
+            pool = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads,
+                    cfg.head_dim)
+            if kv_dtype == "int8":
+                self._k = jnp.zeros(pool, jnp.int8)
+                self._v = jnp.zeros(pool, jnp.int8)
+                self._ks = jnp.zeros(pool[:-1] + (1,), jnp.float32)
+                self._vs = jnp.zeros(pool[:-1] + (1,), jnp.float32)
+            else:
+                self._k = jnp.zeros(pool, cfg.dtype)
+                self._v = jnp.zeros(pool, cfg.dtype)
+                self._ks = self._vs = None
+            # Host mirror of the block table; the device copy is uploaded
+            # (4 bytes/block — KiBs) only on steps whose admissions/frees
+            # changed it, and otherwise donated through decode dispatches
+            # untouched.
+            self._table_np = np.zeros((n_slots, self.n_blocks), np.int32)
+            self._table = self._table_np.copy()
+            self._table_dirty = False
+            self._lens = jnp.zeros((n_slots,), jnp.int32)
+            self._slot_pages: Dict[int, list] = {}   # slot -> page ids
+            self._last_denied: Optional[int] = None  # req id, dedupes metric
         else:
-            cache = init_cache(cfg, n_slots, self.S)
-            self._k, self._v = cache["k"], cache["v"]
-            self._ks = self._vs = None
-        self._bitmap = jnp.zeros((n_slots, self.S), bool)
-        self._cursor = 0
-        self._rope_pos = jnp.zeros((n_slots,), jnp.int32)
+            if kv_dtype == "int8":
+                shape = (cfg.n_layers, n_slots, self.S, cfg.n_kv_heads,
+                         cfg.head_dim)
+                self._k = jnp.zeros(shape, jnp.int8)
+                self._v = jnp.zeros(shape, jnp.int8)
+                self._ks = jnp.zeros(shape[:-1] + (1,), jnp.float32)
+                self._vs = jnp.zeros(shape[:-1] + (1,), jnp.float32)
+            else:
+                cache = init_cache(cfg, n_slots, self.S)
+                self._k, self._v = cache["k"], cache["v"]
+                self._ks = self._vs = None
+            self._bitmap = jnp.zeros((n_slots, self.S), bool)
+            self._cursor = 0
+            self._rope_pos = jnp.zeros((n_slots,), jnp.int32)
         self._last = jnp.zeros((n_slots,), jnp.int32)
         # Host-side bookkeeping (active mask is derived from it each chunk).
         self._slot_req: Dict[int, int] = {}          # slot -> req id
@@ -621,23 +869,41 @@ class ContinuousBatcher:
         self._metrics: Dict[int, Dict[str, float]] = {}
         # params flow through as a runtime argument — binding them via
         # partial would inline every weight into the compiled program as a
-        # constant. Caches/bitmap are donated: each dispatch consumes and
-        # replaces them; without donation every call holds two full copies.
+        # constant. Caches/bitmap (contiguous) or pool/table (paged) are
+        # donated: each dispatch consumes and replaces them; without
+        # donation every call holds two full copies.
         temp, tk = self.temperature, self.top_k
-        self._decode = jax.jit(
-            lambda p, k, v, ks, vs, bm, cur, rp, last, active, seed:
-            _decode_chunk_fn(
-                p, cfg, chunk, mesh, k, v, bm, cur, rp, last, active, seed,
-                temp, tk, k_s=ks, v_s=vs),
-            donate_argnums=(1, 2, 3, 4, 5),
-        )
-        self._prefill = jax.jit(
-            lambda p, k, v, ks, vs, bm, rp, last, slots, curs, tokens,
-            real_lens, seed: _prefill_multi_fn(
-                p, cfg, mesh, k, v, bm, rp, last, slots, curs, tokens,
-                real_lens, seed, temp, tk, k_s=ks, v_s=vs),
-            donate_argnums=(1, 2, 3, 4, 5),
-        )
+        if kv_layout == "paged":
+            ps = self.page_size
+            self._decode = jax.jit(
+                lambda p, k, v, ks, vs, tbl, lens, last, active, seed:
+                _decode_chunk_paged_fn(
+                    p, cfg, chunk, ps, k, v, tbl, lens, last, active, seed,
+                    temp, tk, k_s=ks, v_s=vs),
+                donate_argnums=(1, 2, 3, 4, 5),
+            )
+            self._prefill = jax.jit(
+                lambda p, k, v, ks, vs, lens, last, slots, pids, tokens,
+                real_lens, seed: _prefill_multi_paged_fn(
+                    p, cfg, ps, k, v, lens, last, slots, pids, tokens,
+                    real_lens, seed, temp, tk, k_s=ks, v_s=vs),
+                donate_argnums=(1, 2, 3, 4),
+            )
+        else:
+            self._decode = jax.jit(
+                lambda p, k, v, ks, vs, bm, cur, rp, last, active, seed:
+                _decode_chunk_fn(
+                    p, cfg, chunk, mesh, k, v, bm, cur, rp, last, active,
+                    seed, temp, tk, k_s=ks, v_s=vs),
+                donate_argnums=(1, 2, 3, 4, 5),
+            )
+            self._prefill = jax.jit(
+                lambda p, k, v, ks, vs, bm, rp, last, slots, curs, tokens,
+                real_lens, seed: _prefill_multi_fn(
+                    p, cfg, mesh, k, v, bm, rp, last, slots, curs, tokens,
+                    real_lens, seed, temp, tk, k_s=ks, v_s=vs),
+                donate_argnums=(1, 2, 3, 4, 5),
+            )
 
     # -- API ---------------------------------------------------------------
     def _ladder(self, prompt_len: int) -> int:
@@ -670,6 +936,16 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new ({max_new}) exceeds "
                 f"cache capacity {self.S}")
+        if self.layout == "paged":
+            # Worst-case reservation must fit the POOL, not just the
+            # per-slot logical window — otherwise the request could never
+            # admit and admission (strict FCFS) would spin forever.
+            need = self._pages_needed(len(prompt), max_new)
+            usable = self._alloc.n_pages - 1
+            if need > usable:
+                raise ValueError(
+                    f"request needs {need} pages worst-case but the pool "
+                    f"has only {usable} usable pages")
         req_id = self._next_id
         self._next_id += 1
         self._budget[req_id] = max_new
@@ -689,6 +965,34 @@ class ContinuousBatcher:
         steps = max(0, budget - 1)                   # first token = prefill
         return -(-steps // self.chunk) * self.chunk
 
+    @staticmethod
+    def _group_admissions(adm: list) -> list:
+        """Group one step's admissions into prefill dispatch runs — shared
+        by both layouts (entries are (req_id, slot, ..., bucket) tuples;
+        only positions 1 and 4 are read here). Admissions ride ONE padded
+        dispatch per bucket rung (usually one — see _prefill_multi_fn: M
+        is always n_slots, short lists repeat the LAST entry, which is
+        idempotent; padding with an earlier entry would re-apply writes a
+        slot-reusing later entry already superseded). Writes to distinct
+        slots commute, so same-bucket entries group regardless of
+        interleaving; only when a slot REPEATS within the step (freed by
+        a max_new==1 entry and reused) does cross-group ordering matter,
+        and then we fall back to contiguity-split runs, which preserve
+        admission order per slot."""
+        runs: list = []
+        if len({e[1] for e in adm}) == len(adm):     # all slots distinct
+            by_tb: Dict[int, list] = {}
+            for entry in adm:
+                by_tb.setdefault(entry[4], []).append(entry)
+            runs = list(by_tb.values())
+        else:
+            for entry in adm:
+                if runs and runs[-1][0][4] == entry[4]:
+                    runs[-1].append(entry)
+                else:
+                    runs.append([entry])
+        return runs
+
     def _step_lazy(self) -> list:
         """Admit into free slots and dispatch one decode chunk — WITHOUT
         reading anything back. Returns the req ids that finished this step.
@@ -701,6 +1005,8 @@ class ContinuousBatcher:
         ``device_get``: a drain costs ONE tunnel round trip total instead
         of one per chunk (the per-step readback was 98% of the serving
         bench — 0.88 s of a 0.90 s run — with dispatches at ~3 ms)."""
+        if self.layout == "paged":
+            return self._step_lazy_paged()
         if not self._slot_req and self._cursor:
             # Epoch roll: every slot drained — reclaim the cursor space.
             self._cursor = 0
@@ -744,30 +1050,11 @@ class ContinuousBatcher:
             else:
                 self._slot_req[slot] = req_id
 
-        # Admissions ride ONE padded dispatch per bucket rung (usually one
-        # — see _prefill_multi_fn: M is always n_slots, short lists repeat
-        # the last entry, which is idempotent). Writes to distinct slots
-        # commute, so same-bucket entries group regardless of interleaving;
-        # only when a slot REPEATS within the step (freed by a max_new==1
-        # entry and reused) does cross-group ordering matter, and then we
-        # fall back to contiguity-split runs, which preserve admission
-        # order per slot. Host inputs go in as NUMPY values: the tunnel
-        # device_puts them asynchronously, while converting Python
-        # lists/ints through jnp costs a ~0.7 s synchronous round trip
-        # EACH — measured 185 s of a 188 s serving run.
-        runs: list = []
-        if len({e[1] for e in adm}) == len(adm):     # all slots distinct
-            by_tb: Dict[int, list] = {}
-            for entry in adm:
-                by_tb.setdefault(entry[4], []).append(entry)
-            runs = list(by_tb.values())
-        else:
-            for entry in adm:
-                if runs and runs[-1][0][4] == entry[4]:
-                    runs[-1].append(entry)
-                else:
-                    runs.append([entry])
-        for run in runs:
+        # Host inputs go in as NUMPY values: the tunnel device_puts them
+        # asynchronously, while converting Python lists/ints through jnp
+        # costs a ~0.7 s synchronous round trip EACH — measured 185 s of
+        # a 188 s serving run.
+        for run in self._group_admissions(adm):
             tb = run[0][4]
             # Pad with the LAST entry, not the first: a max_new==1 request
             # frees its slot mid-step, so an earlier entry's slot can be
@@ -819,6 +1106,142 @@ class ContinuousBatcher:
                 del self._slot_req[slot]             # slot free NOW
         self._reads.append(("chunk", toks, takes))
         return finished
+
+    # -- paged step --------------------------------------------------------
+    def _pages_needed(self, prompt_len: int, budget: int) -> int:
+        """Worst-case pages a request can ever touch: its prompt rows plus
+        the chunk-rounded decode rows (the device writes whole chunks for
+        active slots — see _rows_needed), page-granular. Reserved in FULL
+        at admission so a request in flight never stalls on allocation
+        (no mid-decode deadlock); eos early-stop returns the unused tail
+        at finish."""
+        return -(-(prompt_len + self._rows_needed(budget)) // self.page_size)
+
+    def _free_slot_pages(self, slot: int) -> None:
+        self._alloc.free(self._slot_pages.pop(slot))
+        self._table_np[slot] = NULL_PAGE
+        self._table_dirty = True
+
+    def _step_lazy_paged(self) -> list:
+        """The paged-analog of _step_lazy: admission takes free PAGES
+        wherever they are (no contiguous window, no backward-write trick),
+        so the only admission gates are a free slot, free pages, and
+        strict FCFS — and there is NO epoch roll: freed pages recycle
+        immediately, so the all-slots-drained idle boundary the cursor
+        design pays every ~S decode steps simply does not exist."""
+        finished: list = []
+        free = [s for s in range(self.n_slots) if s not in self._slot_req]
+        adm: list = []                 # (req id, slot, pages, prompt, bucket)
+        free_after: list = []          # max_new==1 pages: freed post-dispatch
+        while free and self._queue and len(adm) < self.n_slots:
+            req_id, prompt = self._queue[0]
+            P = len(prompt)
+            pages = self._alloc.alloc(
+                self._pages_needed(P, self._budget[req_id]),
+                count_denied=req_id != self._last_denied)
+            if pages is None:
+                # No pages for the head — STOP admitting (strict FCFS, the
+                # same starvation argument as the contiguous path: letting
+                # smaller requests jump the blocked head would keep the
+                # pool drained and starve it indefinitely). Occupied slots
+                # finish, free their pages, and the head admits. The
+                # denial counts ONCE per request, not once per retry step.
+                self._last_denied = req_id
+                break
+            if req_id == self._last_denied:
+                self._last_denied = None
+            self._queue.pop(0)
+            slot = free.pop()
+            row = self._table_np[slot]
+            row[:] = NULL_PAGE
+            row[:len(pages)] = pages
+            self._table_dirty = True
+            # Bucket rounded up to page granularity: the prefill scatter
+            # writes whole page blocks, so tb must be a page multiple
+            # (ladder rungs below page_size round up to one page).
+            tb = -(-self._ladder(P) // self.page_size) * self.page_size
+            adm.append((req_id, slot, pages, prompt, tb))
+            self._budget[req_id] -= 1                # first token = prefill
+            if self._budget[req_id] <= 0:            # max_new == 1
+                finished.append(req_id)
+                del self._budget[req_id]
+                free.append(slot)                    # slot never occupied
+                # The prefill dispatch below still writes these pages;
+                # they are recycled only after it is enqueued.
+                free_after.append(pages)
+            else:
+                self._slot_req[slot] = req_id
+                self._slot_pages[slot] = pages
+
+        # Same one-padded-dispatch-per-rung grouping as the contiguous
+        # path (_group_admissions: slot-repeat contiguity split, pad with
+        # the LAST entry — duplicate page ids then carry identical
+        # values, keeping the scatter idempotent).
+        for run in self._group_admissions(adm):
+            tb = run[0][4]
+            npg = -(-tb // self.page_size)
+            rows = run + [run[-1]] * (self.n_slots - len(run))
+            tokens = np.asarray(
+                [p + [0] * (tb - len(p)) for _, _, _, p, _ in rows],
+                np.int32)
+            # Page-id matrix for the prefill scatter: the entry's reserved
+            # pages in logical order; the beyond-need tail of an
+            # overshooting bucket targets the null page.
+            pids = np.asarray(
+                [[pg[j] if j < len(pg) else NULL_PAGE for j in range(npg)]
+                 for _, _, pg, _, _ in rows], np.int32)
+            self._dispatch_no += 1
+            (self._k, self._v, self._ks, self._vs, self._lens, self._last,
+             firsts_arr) = self._prefill(
+                self.params, self._k, self._v, self._ks, self._vs,
+                self._lens, self._last,
+                np.asarray([s for _, s, _, _, _ in rows], np.int32),
+                pids, tokens,
+                np.asarray([len(p) for _, _, _, p, _ in rows], np.int32),
+                np.int32(self._dispatch_no))
+            self._reads.append(
+                ("firsts", firsts_arr, [rid for rid, _, _, _, _ in run]))
+        for pages in free_after:
+            self._alloc.free(pages)
+
+        if not self._slot_req:
+            return finished
+        active = np.asarray(
+            [s in self._slot_req for s in range(self.n_slots)])
+        # Upload the table only when admissions/frees changed it (a copy,
+        # so the donated device buffer never aliases the live mirror);
+        # otherwise the previous dispatch's donated-through table is
+        # passed straight back — zero-copy steady state.
+        table = self._table_np.copy() if self._table_dirty else self._table
+        self._table_dirty = False
+        self._dispatch_no += 1
+        (self._k, self._v, self._ks, self._vs, self._table, self._lens,
+         self._last, toks) = self._decode(
+            self.params, self._k, self._v, self._ks, self._vs, table,
+            self._lens, self._last, active, np.int32(self._dispatch_no))
+
+        takes: list = []                             # (req id, slot, n tokens)
+        for slot, req_id in list(self._slot_req.items()):
+            budget = self._budget[req_id]
+            take = min(budget, self.chunk)
+            takes.append((req_id, slot, take))
+            self._budget[req_id] = budget - take
+            if self._budget[req_id] <= 0:
+                finished.append(req_id)
+                del self._budget[req_id]
+                del self._slot_req[slot]             # slot free NOW
+                self._free_slot_pages(slot)          # pages free NOW too
+        self._reads.append(("chunk", toks, takes))
+        return finished
+
+    def pool_metrics(self) -> Dict[str, float]:
+        """Page-pool health (paged layout only; {} otherwise): total/free/
+        in-use/watermark page counts, alloc/free/denied churn, and the
+        instantaneous utilization — the fragmentation-side observability
+        the serving entrypoint publishes next to the latency records."""
+        if self.layout != "paged":
+            return {}
+        return self._alloc.metrics()
 
     def _flush(self) -> None:
         """Materialize every outstanding result array in ONE batched
@@ -881,6 +1304,10 @@ class ContinuousBatcher:
                 del self._slot_req[slot]
                 del self._budget[req_id]
                 self._eos_scanned.pop(req_id, None)
+                if self.layout == "paged":
+                    # Early stop returns the whole worst-case reservation —
+                    # including the never-written tail — immediately.
+                    self._free_slot_pages(slot)
                 reaped.append(req_id)
             else:
                 self._eos_scanned[req_id] = len(out)

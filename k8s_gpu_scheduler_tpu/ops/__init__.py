@@ -38,7 +38,9 @@ from .layers import apply_rope, rms_norm, rope_freqs, swiglu  # noqa: E402
 from .attention import dense_attention, ring_attention, ulysses_attention  # noqa: E402
 from .flash_attention import flash_attention, flash_attention_diff  # noqa: E402
 from .decode_attention import (  # noqa: E402
-    decode_plan, dense_decode_reference, flash_decode_attention,
+    DEFAULT_PAGE_SIZE, decode_plan, dense_decode_reference,
+    flash_decode_attention, gather_paged_kv, paged_decode_attention,
+    paged_plan,
 )
 from .moe import load_balancing_loss, moe_ffn, moe_ffn_dropless  # noqa: E402
 from .quant import dequantize_weight, qdot, quantize_llama_params, quantize_weight  # noqa: E402
@@ -61,6 +63,10 @@ __all__ = [
     "decode_plan",
     "dense_decode_reference",
     "flash_decode_attention",
+    "DEFAULT_PAGE_SIZE",
+    "paged_plan",
+    "paged_decode_attention",
+    "gather_paged_kv",
     "moe_ffn",
     "moe_ffn_dropless",
     "load_balancing_loss",

@@ -41,6 +41,18 @@ reference the kernel is tested against and the automatic fallback for
 shapes the blocking cannot cover. Both run under `JAX_PLATFORMS=cpu` via
 interpret mode (the shared `ops.pallas_interpret` toggle), so tier-1
 exercises the kernel hermetically.
+
+**Paged variant** (`paged_decode_attention`): the same kernel body over a
+vLLM-style paged cache — K/V live in a shared pool of fixed-size pages
+`[n_pages, page_size, Hkv, hd]` and each sequence names its pages through
+a `[B, n_blocks]` BLOCK TABLE that rides as a second scalar-prefetch
+operand. The kv-block grid axis is indirected through the table in the
+BlockSpec index maps (`block_table[b, j]` instead of `j`); the kernel
+body is untouched because the online-softmax math only ever sees LOGICAL
+block coordinates. Everything else carries over: O(pos) traffic via the
+traced length mask with clamped index maps, in-kernel GQA, int8-KV
+dequant in registers, split-K + LSE combine. `gather_paged_kv` is the
+indirection as a dense gather — the reference/fallback path.
 """
 from __future__ import annotations
 
@@ -86,6 +98,45 @@ def decode_plan(s: int, block_k: Optional[int] = None,
     elif n_blocks % n_splits:
         return None
     return block_k, n_splits
+
+
+DEFAULT_PAGE_SIZE = 64
+
+
+def paged_plan(n_blocks: int, page_size: int,
+               n_splits: Optional[int] = None) -> Optional[int]:
+    """Legal split count for a paged cache of ``n_blocks`` logical pages of
+    ``page_size`` rows each, or None when the shape is not pageable: the
+    page IS the kv block, so it must be one of the power-of-two block
+    sizes the kernel's tiling supports (8..256 — the same legal set as
+    ``decode_plan``). Splits engage at >= 8 blocks, like the contiguous
+    plan."""
+    if page_size < 8 or page_size > 256 or page_size & (page_size - 1):
+        return None
+    if n_blocks < 1:
+        return None
+    if n_splits is None:
+        n_splits = 1
+        if n_blocks >= 8:
+            for cand in (8, 4, 2):
+                if n_blocks % cand == 0:
+                    n_splits = cand
+                    break
+        return n_splits
+    if n_blocks % n_splits:
+        return None
+    return n_splits
+
+
+def gather_paged_kv(pages: jax.Array, block_table: jax.Array) -> jax.Array:
+    """Materialize a sequence-contiguous view of a paged pool: pages
+    [n_pages, page_size, ...] gathered through block_table [B, n_blocks]
+    → [B, n_blocks*page_size, ...]. The dense reference/fallback path —
+    O(allocated S) traffic per call, exactly what the paged kernel's
+    table-indirected index maps avoid."""
+    g = pages[block_table]                       # [B, n_blocks, ps, ...]
+    b, n_blocks, ps = g.shape[:3]
+    return g.reshape(b, n_blocks * ps, *pages.shape[2:])
 
 
 def _mask_from(lengths, bitmap, s):
@@ -322,13 +373,143 @@ def flash_decode_attention(
         interpret=interpret,
     )(lengths, *inputs)
 
-    # Split-K combine: standard LSE merge of the per-split partials. An
-    # all-masked split contributes (acc=0, m=-inf, l=0) and drops out; a
-    # fully-masked ROW (length 0 / empty bitmap) yields zeros, unlike the
-    # dense reference's uniform softmax — both are garbage by contract.
+    return _combine_splits(acc, m, l, b, n_heads, hd, q.dtype)
+
+
+def _combine_splits(acc, m, l, b, n_heads, hd, dtype):
+    """Split-K combine: standard LSE merge of the per-split partials. An
+    all-masked split contributes (acc=0, m=-inf, l=0) and drops out; a
+    fully-masked ROW (length 0 / empty bitmap) yields zeros, unlike the
+    dense reference's uniform softmax — both are garbage by contract."""
     m1, l1 = m[..., :1], l[..., :1]                  # [BH, ns, g, 1]
     m_tot = jnp.max(m1, axis=1, keepdims=True)
     w = jnp.exp(m1 - m_tot)
     l_tot = jnp.sum(l1 * w, axis=1)                  # [BH, g, 1]
     out = jnp.sum(acc * w, axis=1) / jnp.maximum(l_tot, 1e-20)
-    return out.reshape(b, n_heads, hd).astype(q.dtype)
+    return out.reshape(b, n_heads, hd).astype(dtype)
+
+
+def _paged_kernel(lengths_ref, table_ref, *rest, **kw):
+    """The paged entry's kernel body IS `_decode_kernel`: the block table
+    only exists in the BlockSpec index maps (physical page naming); the
+    online-softmax math sees logical block coordinates either way."""
+    del table_ref
+    _decode_kernel(lengths_ref, *rest, **kw)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_table: jax.Array,
+    lengths,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    n_splits: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused flash-decode attention over a PAGED KV cache: q [B, H, hd]
+    against a shared page pool k/v [n_pages, page_size, Hkv, hd], each
+    sequence's pages named by ``block_table`` [B, n_blocks] int32 (logical
+    block j of sequence b lives in physical page ``block_table[b, j]``).
+
+    The table rides as a second scalar-prefetch operand and is consumed
+    ONLY by the BlockSpec index maps — logical block blk streams page
+    ``table[b, blk]`` through VMEM, so the pipeline reads exactly the
+    pages a sequence owns, in logical order, with no contiguity
+    requirement on the pool. ``lengths`` (scalar or [B] int32) bounds the
+    filled LOGICAL prefix exactly as in ``flash_decode_attention``: blocks
+    past it are compute-skipped and their index maps clamp to the last
+    valid block (re-naming a resident page — no dead DMA), so traffic is
+    O(pos). ``k_scale``/``v_scale`` [n_pages, page_size, Hkv, 1] switch to
+    int8-KV mode (serving._kv_quant layout, dequant in registers). Rows
+    past ``lengths`` inside the last page may be garbage (stale pages from
+    a freed request) — they are masked, never contributing.
+
+    Raises ValueError when (n_blocks, page_size) has no legal paged plan —
+    callers that want silent degradation check ``paged_plan`` first and
+    fall back to ``gather_paged_kv`` + ``dense_decode_reference``."""
+    b, n_heads, hd = q.shape
+    if k_pages.shape[3] != hd or v_pages.shape != k_pages.shape:
+        raise ValueError(f"page pool shape {k_pages.shape}/{v_pages.shape} "
+                         f"does not match q {q.shape}")
+    if block_table.ndim != 2 or block_table.shape[0] != b:
+        raise ValueError(f"block_table must be [B={b}, n_blocks], got "
+                         f"{block_table.shape}")
+    ps, n_kv = k_pages.shape[1], k_pages.shape[2]
+    n_blocks = block_table.shape[1]
+    if n_heads % n_kv:
+        raise ValueError(
+            f"GQA needs n_heads ({n_heads}) divisible by kv heads ({n_kv})")
+    g = n_heads // n_kv
+    n_splits = paged_plan(n_blocks, ps, n_splits)
+    if n_splits is None:
+        raise ValueError(f"no legal paged blocking for n_blocks={n_blocks}, "
+                         f"page_size={ps}")
+    bps = n_blocks // n_splits
+    quant = k_scale is not None
+    if quant and v_scale is None:
+        raise ValueError("int8-KV mode needs both k_scale and v_scale")
+    from . import pallas_interpret
+    interpret = pallas_interpret(interpret)
+
+    lengths = jnp.asarray(lengths, jnp.int32)
+    if lengths.ndim == 0:
+        lengths = jnp.full((b,), lengths, jnp.int32)
+    block_table = jnp.asarray(block_table, jnp.int32)
+    q3 = q.reshape(b * n_kv, g, hd)
+
+    def kv_map(bh, split, j, lens, table):
+        bb = bh // n_kv
+        blk = split * bps + j                        # LOGICAL kv block
+        last = jnp.maximum(
+            jax.lax.div(lens[bb] + ps - 1, ps) - 1, 0)
+        # The table indirection: the physical page named for this logical
+        # block (clamped past the filled prefix, like the contiguous map).
+        return (table[bb, jnp.minimum(blk, last)], 0, bh % n_kv, 0)
+
+    kv_spec = pl.BlockSpec((1, ps, 1, hd), kv_map)
+    in_specs = [
+        pl.BlockSpec((1, g, hd), lambda bh, split, j, lens, table: (bh, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    inputs = [q3, k_pages, v_pages]
+    if quant:
+        sc_spec = pl.BlockSpec((1, ps, 1, 1), kv_map)
+        in_specs += [sc_spec, sc_spec]
+        inputs += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+
+    part_spec = lambda lanes: pl.BlockSpec(                      # noqa: E731
+        (1, 1, g, lanes),
+        lambda bh, split, j, lens, table: (bh, split, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * n_kv, n_splits, bps),
+        in_specs=in_specs,
+        out_specs=[part_spec(hd), part_spec(_LANES), part_spec(_LANES)],
+        scratch_shapes=[
+            pltpu.VMEM((g, hd), jnp.float32),     # acc
+            pltpu.VMEM((g, _LANES), jnp.float32),  # m
+            pltpu.VMEM((g, _LANES), jnp.float32),  # l
+        ],
+    )
+    kernel = functools.partial(
+        _paged_kernel, scale=1.0 / math.sqrt(hd), block_k=ps,
+        n_kv=n_kv, bps=bps, quant=quant, with_bitmap=False)
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n_kv, n_splits, g, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b * n_kv, n_splits, g, _LANES),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((b * n_kv, n_splits, g, _LANES),
+                                 jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(lengths, block_table, *inputs)
+    return _combine_splits(acc, m, l, b, n_heads, hd, q.dtype)

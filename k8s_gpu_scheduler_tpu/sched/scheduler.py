@@ -23,7 +23,7 @@ from typing import Dict, List, Optional
 
 import time
 
-from ..api.objects import Pod
+from ..api.objects import LABEL_POD_GROUP, Pod
 from ..cluster.apiserver import APIServer
 from ..cluster.informers import SharedInformerFactory
 from ..cluster.resources import Descriptor
@@ -39,9 +39,22 @@ from .framework import (
     WAIT,
     WaitingPod,
 )
-from .queue import SchedulingQueue
+from .queue import SchedulingQueue, pod_priority
 
 log = logging.getLogger(__name__)
+
+
+def pod_class(pod: Pod) -> str:
+    """Latency class for the per-class e2e histograms: ``gang`` (pod-group
+    label — e2e includes Permit quorum wait), ``preempting`` (non-zero
+    priority per queue.pod_priority, the ONE parser of the annotation —
+    e2e includes the victims' eviction), else ``single`` (the
+    kube-comparable population)."""
+    if pod.metadata.labels.get(LABEL_POD_GROUP):
+        return "gang"
+    if pod_priority(pod) > 0:
+        return "preempting"
+    return "single"
 
 
 class Scheduler:
@@ -64,6 +77,18 @@ class Scheduler:
         self._m_e2e = self.metrics.histogram(
             "tpu_sched_e2e_duration_seconds", "Cycle start to successful bind"
         )
+        # Per-class e2e split (VERDICT weak: one distribution for two
+        # populations): gang members' e2e includes Permit quorum wait —
+        # workload shape, not scheduler work — which buries the
+        # kube-comparable singleton tail. Class is derived from the pod
+        # itself (pod-group label / priority annotation), so the split
+        # needs no bench-side cooperation.
+        self._m_e2e_class = {
+            cls: self.metrics.histogram(
+                f"tpu_sched_e2e_duration_seconds_class_{cls}",
+                f"Cycle start to successful bind, {cls} pods")
+            for cls in ("single", "gang", "preempting")
+        }
         self._m_attempts = self.metrics.counter(
             "tpu_sched_attempts_total", "Scheduling attempts by result"
         )
@@ -453,7 +478,9 @@ class Scheduler:
         self._m_attempts.inc(result="scheduled")
         start = state.read("cycle_start")
         if start is not None:
-            self._m_e2e.observe(time.perf_counter() - start)
+            dt = time.perf_counter() - start
+            self._m_e2e.observe(dt)
+            self._m_e2e_class[pod_class(pod)].observe(dt)
         with self._fail_mu:
             self.failure_reasons.pop(pod.metadata.key, None)
         for pl in self.profile.post_bind:

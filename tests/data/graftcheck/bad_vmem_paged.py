@@ -1,0 +1,18 @@
+"""Seeded-bad fixture: a paged decode footprint over the VMEM budget.
+
+Same ``GRAFTCHECK_VMEM_AUDIT`` hook protocol as bad_vmem.py, paged
+edition: a page size of 8192 rows of hd=512 int8 K/V (double-buffered,
+plus f32 scale planes and a 64-wide block table for a batch of 32) is
+~18 MiB of page blocks against the 16 MiB core — the kind of "just make
+the pages bigger" tuning mistake the budgeter exists to catch before
+Mosaic does, in production, at the first long-context config.
+"""
+from k8s_gpu_scheduler_tpu.analysis.vmem import (
+    paged_decode_attention_footprint,
+)
+
+GRAFTCHECK_VMEM_AUDIT = [
+    ("oversized_paged_decode",
+     paged_decode_attention_footprint(page_size=8192, g=32, hd=512,
+                                      n_blocks=64, batch=32, quant=True)),
+]

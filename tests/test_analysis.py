@@ -18,7 +18,8 @@ import pytest
 
 from k8s_gpu_scheduler_tpu.analysis import (
     VMEM_BYTES_PER_CORE, audit_vmem, decode_attention_footprint,
-    flash_attention_footprint, run_fast_passes, parse_suppressions,
+    flash_attention_footprint, paged_decode_attention_footprint,
+    run_fast_passes, parse_suppressions,
 )
 from k8s_gpu_scheduler_tpu.analysis.astlint import lint_source
 from k8s_gpu_scheduler_tpu.analysis.vmem import KernelFootprint
@@ -183,6 +184,41 @@ class TestVmem:
         bwd = flash_attention_footprint(256, 256, 128, backward=True)
         assert bwd.total > fwd.total - 2 ** 17  # same ballpark, bwd-heavy
 
+    def test_paged_footprint_fits_and_rejects(self):
+        """The paged plan at serving shapes fits comfortably (the page is
+        the kv block — same working set as contiguous plus the block-table
+        scalars); a pathological page size blows the budget."""
+        fp = paged_decode_attention_footprint(64, 4, 128, 128, quant=True)
+        assert fp.check() == []
+        # The table scalars are counted: more blocks -> more bytes.
+        fp_wide = paged_decode_attention_footprint(64, 4, 128, 1024,
+                                                   batch=64, quant=True)
+        assert fp_wide.total > fp.total
+        bad = paged_decode_attention_footprint(8192, 32, 512, 64,
+                                               batch=32, quant=True)
+        findings = bad.check()
+        assert len(findings) == 1 and findings[0].rule == "vmem-budget"
+
+    def test_paged_page_size_divisibility_finding(self, monkeypatch):
+        """A preset cache length the default page size does not divide
+        must surface as block-divisibility from audit_vmem's PAGED arm —
+        driven end-to-end by injecting a trap preset (S=96: the
+        contiguous plan still exists at block 32, so only the paged gate
+        can fire)."""
+        from k8s_gpu_scheduler_tpu.analysis import vmem
+        from k8s_gpu_scheduler_tpu.models.llama import LlamaConfig
+
+        assert 96 % 64 != 0 and 96 % 32 == 0
+        monkeypatch.setattr(vmem, "_presets", lambda: [
+            ("trap", LlamaConfig.tiny(), {"cache_lens": (96,)})])
+        findings = vmem.audit_vmem()
+        paged = [f for f in findings if "paged" in f.message]
+        assert len(paged) == 1 and paged[0].rule == "block-divisibility"
+        assert "page_size=64" in paged[0].message
+        # ... and nothing else fires for the trap preset (the contiguous
+        # plan and the flash blocks are legal at these shapes).
+        assert findings == paged
+
 
 # -- jaxpr audit --------------------------------------------------------------
 
@@ -328,6 +364,69 @@ class TestBatcherSteadyState:
         eng.run()                                  # drain the long request
         # fixture teardown re-asserts steady state
 
+    def test_paged_three_chunks_varying_tables_zero_retrace(
+            self, recompile_guard):
+        """Paged edition of the regression above: steady-state decode
+        across chunks whose BLOCK TABLES differ (each wave's admission
+        lands on recycled pages in a different physical order) must be
+        zero-retrace — the table varies in content, never in shape — and
+        the page pool AND the table must ride the donation chain (the
+        table is donated-through unchanged on steps with no admission/
+        free, which still has to alias rather than copy)."""
+        import jax
+
+        from k8s_gpu_scheduler_tpu.models.llama import (
+            LlamaConfig, init_params,
+        )
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = LlamaConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=48,
+                                chunk=2, prefill_bucket=8, kv_dtype="int8",
+                                kv_layout="paged", page_size=8)
+        rng = np.random.default_rng(0)
+        # Warmup: covers the prefill rung and the decode chunk program.
+        eng.submit(rng.integers(0, cfg.vocab, 5), max_new=3)
+        eng.run()
+        # A long-running request pins a slot so pure-decode steps exist
+        # after the admission waves.
+        eng.submit(rng.integers(0, cfg.vocab, 5), max_new=15)
+        eng.step()
+        # One pure step completes the warmup: a no-admission chunk passes
+        # the DEVICE-resident table (committed), which jit caches under a
+        # different key than the numpy upload of admission steps — both
+        # variants must be resident before the zero-retrace window.
+        eng.step()
+
+        recompile_guard.track("decode", eng._decode)
+        recompile_guard.track("prefill", eng._prefill)
+        recompile_guard.snapshot()
+        # Read the tables the decode dispatches actually carried (the
+        # host mirror re-zeroes a row the moment its request frees, but
+        # the device table of each step still shows the wave's pages).
+        tables = [np.asarray(eng._table)]
+        for plen in (4, 6, 8):
+            eng.submit(rng.integers(0, cfg.vocab, plen), max_new=2)
+            k_before = eng._k
+            eng.step()
+            # Donation held for the pool on every dispatch.
+            assert k_before.is_deleted(), "kv page pool was not donated"
+            tables.append(np.asarray(eng._table))
+        # The waves really did vary the table (recycled pages, different
+        # physical placement per wave).
+        assert any((a != b).any() for a, b in zip(tables, tables[1:]))
+        # Two pure decode steps (no admission/free): the device-resident
+        # table is donated-through — consumed, not copied.
+        eng.step()
+        tbl_before, k_before = eng._table, eng._k
+        assert hasattr(tbl_before, "is_deleted"), "table should be on device"
+        eng.step()
+        assert k_before.is_deleted(), "kv page pool was not donated"
+        assert tbl_before.is_deleted(), "block table was not donated"
+        assert recompile_guard.misses_since() == {"decode": 0, "prefill": 0}
+        eng.run()                                  # drain the long request
+
 
 # -- CLI contract -------------------------------------------------------------
 
@@ -346,7 +445,8 @@ class TestCli:
         assert proc.returncode == 0, proc.stderr
 
     def test_reintroduced_fast_fixtures_fail(self):
-        for fixture in ("bad_astlint.py", "bad_vmem.py"):
+        for fixture in ("bad_astlint.py", "bad_vmem.py",
+                        "bad_vmem_paged.py"):
             proc = run_cli(os.path.join(FIXTURES, fixture))
             assert proc.returncode == 1, (fixture, proc.stderr)
             assert ": [" in proc.stderr       # file:line: [rule] rendering

@@ -1,0 +1,433 @@
+"""Paged KV cache (ops/decode_attention.py paged_* + the paged
+ContinuousBatcher) — parity against the contiguous fused and dense paths.
+
+The paged kernel reuses the contiguous kernel's online-softmax/split-K
+body; only the BlockSpec index maps change (logical kv block j streams
+physical page ``block_table[b, j]``). So the parity matrix here pins the
+TABLE INDIRECTION — pools are built by scattering a known contiguous
+cache through a random page permutation, and every output must match the
+contiguous kernel and the dense reference bit-for-tolerance. The engine
+tests pin the layout end-to-end: paged and contiguous ContinuousBatchers
+must emit identical token streams, and the admission test demonstrates
+the design win — a prompt the contiguous cursor window rejects admits
+immediately against fragmented free pages, with no epoch-roll idle step.
+
+Everything runs in interpret mode on CPU (ops.pallas_interpret); the
+same kernel compiles on TPU, where `bench.py --leg paged_attention`
+measures it.
+"""
+import dataclasses
+import json
+import math
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_scheduler_tpu.ops import (
+    dense_decode_reference, flash_decode_attention, gather_paged_kv,
+    paged_decode_attention, paged_plan,
+)
+
+TOL = {jnp.float32: 3e-6, jnp.bfloat16: 4e-2}
+
+
+def maxdiff(a, b):
+    return float(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)).max())
+
+
+def paged_case(B=2, H=8, Hkv=4, hd=32, S=64, ps=16, dtype=jnp.float32,
+               seed=0, perm_seed=0):
+    """A contiguous cache plus its paged twin: pages scattered through a
+    random permutation (page 0 reserved as null), table mapping logical
+    blocks back to them."""
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, H, hd), dtype)
+    k = jax.random.normal(ks[1], (B, S, Hkv, hd), dtype)
+    v = jax.random.normal(ks[2], (B, S, Hkv, hd), dtype)
+    nb = S // ps
+    n_pages = 1 + B * nb
+    rng = np.random.default_rng(perm_seed)
+    table = rng.permutation(np.arange(1, n_pages)).reshape(B, nb)
+    kp = jnp.zeros((n_pages, ps, Hkv, hd), dtype)
+    vp = jnp.zeros((n_pages, ps, Hkv, hd), dtype)
+    kp = kp.at[table].set(k.reshape(B, nb, ps, Hkv, hd))
+    vp = vp.at[table].set(v.reshape(B, nb, ps, Hkv, hd))
+    return q, k, v, kp, vp, jnp.asarray(table, jnp.int32)
+
+
+class TestPagedPlan:
+    def test_plan_legality(self):
+        assert paged_plan(128, 64) == 8
+        assert paged_plan(4, 16) == 1
+        assert paged_plan(12, 32) == 4
+        assert paged_plan(4, 48) is None             # not a pow2 page
+        assert paged_plan(4, 4) is None              # page below tile min
+        assert paged_plan(4, 512) is None            # page above block max
+        assert paged_plan(8, 16, 3) is None          # splits must divide
+        assert paged_plan(8, 16, 4) == 4
+
+    def test_unsupported_shapes_raise(self):
+        q, k, v, kp, vp, table = paged_case()
+        with pytest.raises(ValueError):
+            paged_decode_attention(q, kp, vp, table, 50, n_splits=3,
+                                   interpret=True)
+        q6 = jax.random.normal(jax.random.PRNGKey(0), (2, 6, 32))
+        with pytest.raises(ValueError):
+            paged_decode_attention(q6, kp, vp, table, 50, interpret=True)
+
+    def test_gather_inverts_the_permutation(self):
+        q, k, v, kp, vp, table = paged_case()
+        assert maxdiff(gather_paged_kv(kp, table), k) == 0.0
+        assert maxdiff(gather_paged_kv(vp, table), v) == 0.0
+
+
+class TestPagedParity:
+    """The indirection matrix: paged kernel vs the contiguous fused kernel
+    vs the dense reference, across GQA ratios, dtypes, raggedness, int8-KV
+    and split-K — the acceptance parity grid."""
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("hkv", [8, 2, 1])           # Hkv = H, H/4, H/8
+    def test_gqa_and_dtypes(self, dtype, hkv):
+        q, k, v, kp, vp, table = paged_case(Hkv=hkv, dtype=dtype)
+        lengths = jnp.array([17, 63])
+        ref = dense_decode_reference(q, k, v, lengths=lengths)
+        fused = flash_decode_attention(q, k, v, lengths, block_k=16,
+                                       interpret=True)
+        out = paged_decode_attention(q, kp, vp, table, lengths,
+                                     interpret=True)
+        assert out.dtype == q.dtype
+        assert maxdiff(out, ref) < TOL[dtype]
+        # Same kernel body either side of the indirection: paged and
+        # contiguous fused agree to float-noise, not just to dense-tol.
+        assert maxdiff(out, fused) < TOL[dtype]
+
+    def test_ragged_fill_lengths(self):
+        """pos = 0, 1, page-1, page, S-1 with ps=16: every page-boundary
+        case of the traced length mask (lengths = pos+1)."""
+        B = 5
+        q, k, v, kp, vp, table = paged_case(B=B)
+        lengths = jnp.array([1, 2, 16, 17, 64])      # pos + 1
+        ref = dense_decode_reference(q, k, v, lengths=lengths)
+        out = paged_decode_attention(q, kp, vp, table, lengths,
+                                     interpret=True)
+        assert maxdiff(out, ref) < 1e-5
+
+    def test_scalar_length_broadcasts(self):
+        q, k, v, kp, vp, table = paged_case()
+        ref = dense_decode_reference(q, k, v, lengths=jnp.array([23, 23]))
+        out = paged_decode_attention(q, kp, vp, table, 23, interpret=True)
+        assert maxdiff(out, ref) < 1e-5
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_int8_kv(self, dtype):
+        from k8s_gpu_scheduler_tpu.models.serving import _kv_quant
+
+        q, k, v, kp, vp, table = paged_case(dtype=dtype)
+        kq, ks = _kv_quant(k)
+        vq, vs = _kv_quant(v)
+        # Quantize the POOL the same way the engine does (per-row scales
+        # travel with their page).
+        kpq, kps = _kv_quant(kp)
+        vpq, vps = _kv_quant(vp)
+        lengths = jnp.array([9, 64])
+        ref = dense_decode_reference(q, kq, vq, lengths=lengths,
+                                     k_scale=ks, v_scale=vs)
+        out = paged_decode_attention(q, kpq, vpq, table, lengths,
+                                     k_scale=kps, v_scale=vps,
+                                     interpret=True)
+        assert maxdiff(out, ref) < TOL[dtype]
+
+    def test_split_k_combine(self):
+        """Split-K over the block-table axis: logical splits whose pages
+        are physically scattered must still LSE-combine to the dense
+        answer, including splits entirely past the filled prefix."""
+        q, k, v, kp, vp, table = paged_case(S=128, ps=16)
+        lengths = jnp.array([5, 100])                # split 4 dead for row 0
+        ref = dense_decode_reference(q, k, v, lengths=lengths)
+        one = paged_decode_attention(q, kp, vp, table, lengths, n_splits=1,
+                                     interpret=True)
+        four = paged_decode_attention(q, kp, vp, table, lengths, n_splits=4,
+                                      interpret=True)
+        assert maxdiff(one, ref) < 1e-5
+        assert maxdiff(four, ref) < 1e-5
+        assert maxdiff(four, one) < 1e-5
+
+    def test_permutation_invariance(self):
+        """The physical page order is INVISIBLE: two pools holding the
+        same logical cache under different permutations produce
+        identical outputs."""
+        q, k, v, kp1, vp1, t1 = paged_case(perm_seed=1)
+        _, _, _, kp2, vp2, t2 = paged_case(perm_seed=2)
+        lengths = jnp.array([33, 61])
+        a = paged_decode_attention(q, kp1, vp1, t1, lengths, interpret=True)
+        b = paged_decode_attention(q, kp2, vp2, t2, lengths, interpret=True)
+        assert maxdiff(a, b) < 1e-6
+
+    def test_stale_tail_rows_are_masked(self):
+        """Rows past `lengths` inside the last page carry stale garbage
+        from freed requests by design — poison them and assert the
+        output is untouched."""
+        q, k, v, kp, vp, table = paged_case()
+        lengths = jnp.array([18, 30])                # mid-page fills
+        poisoned_k, poisoned_v = kp, vp
+        for b in range(2):
+            pos = int(lengths[b])
+            pg = table[b, pos // 16]
+            poisoned_k = poisoned_k.at[pg, pos % 16:].set(1e4)
+            poisoned_v = poisoned_v.at[pg, pos % 16:].set(1e4)
+        clean = paged_decode_attention(q, kp, vp, table, lengths,
+                                       interpret=True)
+        dirty = paged_decode_attention(q, poisoned_k, poisoned_v, table,
+                                       lengths, interpret=True)
+        assert maxdiff(clean, dirty) == 0.0
+
+    def test_runs_under_jit_and_scan(self):
+        q, k, v, kp, vp, table = paged_case()
+        lengths = jnp.array([17, 63])
+        ref = dense_decode_reference(q, k, v, lengths=lengths)
+
+        def step(c, _):
+            return c, paged_decode_attention(q, kp, vp, table, lengths)
+
+        _, outs = jax.jit(
+            lambda: jax.lax.scan(step, 0, None, length=2))()
+        assert maxdiff(outs[1], ref) < 1e-5
+
+
+class TestPagedEngine:
+    """The layout end-to-end: a paged ContinuousBatcher must be token-
+    identical to the contiguous engine, and admission must be free of the
+    cursor design's contiguity constraint and epoch roll."""
+
+    def _cfg(self, **kw):
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig
+
+        return dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                                   **kw)
+
+    def _run(self, cfg, layout, prompts, max_new=5, **kw):
+        from k8s_gpu_scheduler_tpu.models import init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=32, chunk=4,
+                                prefill_bucket=8, kv_layout=layout,
+                                page_size=8, **kw)
+        ids = [eng.submit(p, max_new=max_new) for p in prompts]
+        done = eng.run()
+        return [done[i] for i in ids], eng
+
+    @pytest.mark.parametrize("kvd", [None, "int8"])
+    @pytest.mark.parametrize("impl", ["dense", "fused"])
+    def test_paged_matches_contiguous_engine(self, impl, kvd):
+        cfg = self._cfg(decode_attn=impl)
+        rng = np.random.default_rng(0)
+        prompts = [list(rng.integers(0, cfg.vocab, n)) for n in (3, 5, 4)]
+        paged, peng = self._run(cfg, "paged", prompts, kv_dtype=kvd)
+        contig, _ = self._run(cfg, "contiguous", prompts, kv_dtype=kvd)
+        assert paged == contig
+        # Every page came back at drain.
+        m = peng.pool_metrics()
+        assert m["pages_in_use"] == 0 and m["pages_free"] == m["pages_total"]
+        assert m["pages_watermark"] > 0
+
+    def test_generate_token_identity(self):
+        """Single request through the paged engine == the static generate
+        path (greedy, f32 params — no near-tie noise)."""
+        from k8s_gpu_scheduler_tpu.models import generate, init_params
+
+        cfg = self._cfg(decode_attn="fused")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0,
+                                    cfg.vocab)
+        ref = generate(params, prompt, cfg, max_new=6, max_len=32)
+        out, _ = self._run(cfg, "paged", [list(np.asarray(prompt[0]))],
+                           max_new=6)
+        # generate emits max_new CONTINUATION tokens; the engine's stream
+        # starts at the same first token (prefill argmax).
+        assert out[0] == list(np.asarray(ref[0]))
+
+    def test_fragmented_admission_no_epoch_roll(self):
+        """The acceptance scenario: a long prompt the contiguous cursor
+        window REJECTS (cursor too far advanced, epoch roll pending)
+        admits immediately against fragmented free pages — while another
+        request is still decoding, i.e. with no all-slots-drained idle
+        step — and the final token streams are identical anyway."""
+        from k8s_gpu_scheduler_tpu.models import init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = self._cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        pA = list(rng.integers(0, cfg.vocab, 4))     # long-running pin
+        pB = list(rng.integers(0, cfg.vocab, 4))     # finishes early
+        pC = list(rng.integers(0, cfg.vocab, 20))    # the blocked head
+
+        def drive(layout):
+            eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=32,
+                                    chunk=4, prefill_bucket=8,
+                                    kv_layout=layout, page_size=8)
+            a = eng.submit(pA, max_new=29)
+            b = eng.submit(pB, max_new=5)
+            done = {}
+            for _ in range(5):                       # B done, cursor >= 24
+                done.update(eng.step())
+            c = eng.submit(pC, max_new=5)
+            done.update(eng.step())
+            admitted = c not in [rid for rid, _ in eng._queue]
+            slot_still_active = bool(eng._slot_req)  # A still decoding
+            steps = 6
+            while eng.pending:
+                done.update(eng.step())
+                steps += 1
+            return admitted, slot_still_active, steps, \
+                {k: done[k] for k in (a, b, c)}
+
+        p_adm, p_active, p_steps, p_out = drive("paged")
+        c_adm, _, c_steps, c_out = drive("contiguous")
+        assert p_adm, "paged admission should take fragmented free pages"
+        assert p_active, "admission must not wait for an all-slots drain"
+        assert not c_adm, \
+            "scenario broken: the contiguous cursor window admitted too"
+        assert p_steps < c_steps, "paged should skip the epoch-roll wait"
+        assert p_out == c_out
+
+    def test_page_exhaustion_blocks_then_recovers(self):
+        """A pool too small for two concurrent requests serializes them
+        (strict FCFS on page shortage) instead of deadlocking or
+        corrupting streams."""
+        from k8s_gpu_scheduler_tpu.models import init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = self._cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(1)
+        # Each request needs ceil((4+8)/8) = 2 pages; the pool has 3
+        # usable — the second admission must wait for the first to free.
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=32,
+                                chunk=4, prefill_bucket=8,
+                                kv_layout="paged", page_size=8, n_pages=4)
+        prompts = [list(rng.integers(0, cfg.vocab, 4)) for _ in range(2)]
+        ids = [eng.submit(p, max_new=9) for p in prompts]
+        eng.step()
+        assert len(eng._slot_req) == 1               # second is page-blocked
+        assert eng._alloc.metrics()["page_denied"] >= 1
+        done = eng.run()
+        assert sorted(done) == sorted(ids)
+        assert all(len(done[i]) == 9 for i in ids)
+        assert eng.pool_metrics()["pages_in_use"] == 0
+
+    def test_eos_frees_pages_early(self):
+        from k8s_gpu_scheduler_tpu.models import init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = self._cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        # Find the first decode token greedily, then use it as eos so the
+        # request reaps on its first chunk with budget left.
+        probe = ContinuousBatcher(params, cfg, n_slots=1, max_len=32,
+                                  chunk=2, prefill_bucket=8,
+                                  kv_layout="paged", page_size=8)
+        rid = probe.submit([5, 7, 11], max_new=4)
+        first_tokens = probe.run()[rid]
+        eos = first_tokens[1]
+        eng = ContinuousBatcher(params, cfg, n_slots=1, max_len=32,
+                                chunk=2, prefill_bucket=8,
+                                kv_layout="paged", page_size=8, eos_id=eos)
+        rid = eng.submit([5, 7, 11], max_new=20)
+        out = eng.run()[rid]
+        assert out[-1] == eos and len(out) < 20
+        assert eng.pool_metrics()["pages_in_use"] == 0
+
+    def test_paged_rejects_mesh_and_bad_page_size(self):
+        from k8s_gpu_scheduler_tpu.models import init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = self._cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="divisible"):
+            ContinuousBatcher(params, cfg, n_slots=1, max_len=36,
+                              kv_layout="paged", page_size=8)
+        with pytest.raises(ValueError, match="kv_layout"):
+            ContinuousBatcher(params, cfg, n_slots=1, max_len=32,
+                              kv_layout="paging")
+        # A request whose worst-case reservation exceeds the whole pool
+        # could never admit — submit refuses instead of spinning FCFS.
+        small = ContinuousBatcher(params, cfg, n_slots=1, max_len=32,
+                                  chunk=4, kv_layout="paged", page_size=8,
+                                  n_pages=3)
+        with pytest.raises(ValueError, match="pages"):
+            small.submit([1, 2, 3], max_new=20)
+
+
+class TestPageAllocator:
+    def test_double_free_and_foreign_page_rejected(self):
+        """A double free must raise BEFORE mutating state: the same id on
+        the free list twice would hand one physical page to two requests
+        — silent KV cross-contamination (PageAllocator is public API,
+        not protected by the engine's bookkeeping discipline)."""
+        from k8s_gpu_scheduler_tpu.models.paging import PageAllocator
+
+        a = PageAllocator(9)
+        held_a, held_b = a.alloc(4), a.alloc(4)
+        a.free(held_b)
+        with pytest.raises(RuntimeError, match="double free"):
+            a.free(held_b)
+        assert a.in_use == 4 and a.free_count == 4   # state unchanged
+        with pytest.raises(RuntimeError, match="double free"):
+            a.free([99])                             # never handed out
+        with pytest.raises(ValueError, match="null page"):
+            a.free([0])
+        a.free(held_a)
+        m = a.metrics()
+        assert m["pages_in_use"] == 0 and m["pages_free"] == 8
+
+    def test_all_or_nothing_and_watermark(self):
+        from k8s_gpu_scheduler_tpu.models.paging import PageAllocator
+
+        a = PageAllocator(5)
+        first = a.alloc(3)
+        assert a.alloc(2) is None                    # only 1 free
+        assert a.metrics()["page_denied"] == 1
+        a.free(first)
+        assert a.alloc(4) is not None
+        assert a.metrics()["pages_watermark"] == 4
+
+
+class TestBenchLeg:
+    def test_paged_attention_microbench_smoke(self):
+        """`bench.py --leg paged_attention --smoke` must emit ONE JSON
+        line with paged-vs-contiguous fused-vs-dense tokens/s for both
+        cache dtypes plus cache bytes and page utilization — the contract
+        the CI bench-contract job and future BENCH_*.json capture ride
+        on."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "bench.py", "--leg", "paged_attention",
+             "--smoke"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            capture_output=True, text=True, timeout=600, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+        assert len(lines) == 1, out.stdout
+        rec = json.loads(lines[0])
+        assert rec["metric"] == "paged_attention_microbench"
+        extra = rec["extra"]
+        for key in ("pagedattn_contig_fused_bf16_tok_s",
+                    "pagedattn_paged_fused_bf16_tok_s",
+                    "pagedattn_contig_fused_int8kv_tok_s",
+                    "pagedattn_paged_fused_int8kv_tok_s",
+                    "pagedattn_paged_dense_bf16_tok_s",
+                    "pagedattn_contig_dense_bf16_tok_s",
+                    "pagedattn_bytes_per_step_bf16",
+                    "pagedattn_bytes_per_step_int8kv"):
+            assert key in extra and extra[key] > 0, (key, extra)
+        for key in ("paged_engine_page_utilization_peak",
+                    "paged_engine_pages_total"):
+            assert key in extra and extra[key] > 0, (key, extra)

@@ -263,6 +263,45 @@ class TestSchedulerCycle:
         finally:
             sched.stop()
 
+    def test_per_class_e2e_histograms(self):
+        """Every bind lands in exactly one per-class e2e histogram (the
+        mixed1024 bench's per-population split): plain pods in `single`,
+        pod-group-labelled in `gang`, priority-annotated in
+        `preempting` — and the classes partition the aggregate count."""
+        from k8s_gpu_scheduler_tpu.api.objects import LABEL_POD_GROUP
+        from k8s_gpu_scheduler_tpu.sched.scheduler import pod_class
+
+        assert pod_class(mk_pod("a")) == "single"
+        assert pod_class(mk_pod("b", priority=50)) == "preempting"
+        gangish = mk_pod("c", priority=50)
+        gangish.metadata.labels[LABEL_POD_GROUP] = "g1"
+        assert pod_class(gangish) == "gang"          # group label wins
+
+        server = APIServer()
+        d = Descriptor(server)
+        server.create(mk_node("n0", chips=8))
+        sched = make_scheduler(server)
+        sched.start()
+        try:
+            d.create_pod(mk_pod("plain-0", chips=1))
+            d.create_pod(mk_pod("plain-1", chips=1))
+            d.create_pod(mk_pod("prio-0", chips=1, priority=10))
+            assert wait_until(
+                lambda: sched.metrics.histogram(
+                    "tpu_sched_e2e_duration_seconds").count == 3)
+            single = sched.metrics.histogram(
+                "tpu_sched_e2e_duration_seconds_class_single")
+            preempting = sched.metrics.histogram(
+                "tpu_sched_e2e_duration_seconds_class_preempting")
+            gang = sched.metrics.histogram(
+                "tpu_sched_e2e_duration_seconds_class_gang")
+            assert single.count == 2
+            assert preempting.count == 1
+            assert gang.count == 0
+            assert (single.quantile(0.99) or 0) > 0
+        finally:
+            sched.stop()
+
     def test_scores_pick_emptiest_node(self):
         server = APIServer()
         d = Descriptor(server)
