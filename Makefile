@@ -36,6 +36,7 @@ bench:
 # decode kernels (the full-line bench runs them too; these are seconds).
 bench-smoke:
 	$(PY) bench.py --leg paged_attention --smoke
+	$(PY) bench.py --leg prefix_cache --smoke
 	$(PY) bench.py --leg decode_attention --smoke
 
 demo: native

@@ -543,6 +543,12 @@ def bench_serving():
     out.update(_bench_serving_int8())
     out.update(_bench_serving_longctx())
     out.update(_bench_serving_8b_full())
+    try:
+        # Shared-prefix reuse leg: hit rate, prefill tokens skipped and
+        # the cache-on/off TTFT before/after on the same workload.
+        out.update(bench_prefix_cache()["extra"])
+    except Exception as e:  # noqa: BLE001 — reuse leg must not kill the line
+        out["prefix_cache_error"] = str(e)[:200]
     return out
 
 
@@ -930,6 +936,114 @@ def _paged_engine_utilization():
     }
 
 
+def bench_prefix_cache(smoke=False):
+    """Shared-prefix serving leg — the prefix cache's value proposition
+    measured end-to-end: N requests over K distinct system prompts (the
+    many-users-few-prompts regime the ROADMAP north star implies) through
+    a paged ContinuousBatcher with `prefix_cache=True`, step()-driven so
+    admission-to-first-token pays the real readback cadence. Reports
+    TTFT percentiles cache-on AND cache-off on the identical workload
+    (the before/after), prefill tokens skipped, token- and
+    request-weighted hit rates, page utilization and evictions. On CPU
+    (or --smoke) the model is tiny and fused attention runs interpreted —
+    the numbers prove the leg end-to-end; the TPU run under the driver is
+    what BENCH_*.json captures."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if smoke or not on_tpu:
+        cfg = dataclasses.replace(LlamaConfig.tiny(), decode_attn="fused")
+        n_req, n_sys, sys_len, suffix, max_new = 24, 2, 24, 6, 4
+        eng_kw = dict(n_slots=4, max_len=64, chunk=4, prefill_bucket=8,
+                      page_size=8)
+    else:
+        # The serving regime of _bench_serving_longctx, shared-prefix
+        # edition: few long system prompts, short novel suffixes.
+        cfg = LlamaConfig(
+            vocab=32000, d_model=1024, n_layers=4, n_heads=16,
+            n_kv_heads=16, d_ff=4096, max_seq=2048, remat=False,
+            decode_attn="fused")
+        n_req, n_sys, sys_len, suffix, max_new = 48, 4, 960, 32, 32
+        eng_kw = dict(n_slots=8, max_len=2048, chunk=32,
+                      prefill_bucket=128, page_size=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    sys_prompts = [list(rng.integers(0, cfg.vocab, sys_len))
+                   for _ in range(n_sys)]
+    workload = [sys_prompts[i % n_sys]
+                + list(rng.integers(0, cfg.vocab, suffix))
+                for i in range(n_req)]
+
+    def drive(prefix_cache: bool):
+        eng = ContinuousBatcher(params, cfg, kv_dtype="int8",
+                                kv_layout="paged",
+                                prefix_cache=prefix_cache, **eng_kw)
+        # Warm OUTSIDE the measured window: two waves over the K system
+        # prompts — the first misses and (cache on) donates them into the
+        # tree, the second hits, so every (tb, hb) prefill rung the
+        # measured workload uses is compiled and the cache is in its
+        # steady state (K hot system prompts — the workload's premise).
+        for _ in range(2):
+            for sp in sys_prompts:
+                eng.submit(sp + list(rng.integers(0, cfg.vocab, suffix)),
+                           max_new=2)
+            while eng.pending:
+                eng.step()
+        eng.pop_request_metrics()
+        warm = eng.pool_metrics()
+        t0 = time.perf_counter()
+        for p in workload:
+            eng.submit(p, max_new=max_new)
+        while eng.pending:
+            eng.step()
+        wall = time.perf_counter() - t0
+        eng._alloc.assert_consistent()
+        return eng, warm, wall, eng.pop_request_metrics()
+
+    eng_on, warm, wall_on, met_on = drive(True)
+    eng_off, _, wall_off, met_off = drive(False)
+    m = eng_on.pool_metrics()
+
+    def delta_rate(hit_key, total_key):
+        num = m[hit_key] - warm[hit_key]
+        den = m[total_key] - warm[total_key]
+        return round(num / den, 4) if den else 0.0
+
+    extra = {
+        "prefix_cache_shape": f"{n_req} reqs x {n_sys} sys prompts "
+                              f"(sys {sys_len} + suffix {suffix})",
+        "prefix_cache_interpret": not on_tpu,
+        # Measured-window deltas: the steady-state numbers, not diluted
+        # by the warmup's compulsory misses.
+        "prefix_cache_tokens_skipped": m["prefill_tokens_skipped"]
+                                       - warm["prefill_tokens_skipped"],
+        "prefix_cache_hit_rate": delta_rate("prefix_hit_tokens",
+                                            "prefix_lookup_tokens"),
+        "prefix_cache_request_hit_rate": delta_rate("prefix_lookup_hits",
+                                                    "prefix_lookups"),
+        "prefix_cache_cached_pages": m["prefix_cached_pages"],
+        "prefix_cache_evictions": m["prefix_evictions"],
+        "prefix_cache_page_utilization": round(m["page_utilization"], 4),
+        "prefix_cache_tok_s": round(n_req * max_new / wall_on, 1),
+        "prefix_cache_off_tok_s": round(n_req * max_new / wall_off, 1),
+    }
+    extra.update(_latency_stats(met_on, prefix="prefix_cache_"))
+    extra.update(_latency_stats(met_off, prefix="prefix_cache_off_"))
+    return {
+        "metric": "prefix_cache_bench",
+        "value": extra["prefix_cache_request_hit_rate"],
+        "unit": "hit_rate",
+        "extra": extra,
+    }
+
+
 def bench_analysis(smoke=False):
     """graftcheck latency leg: wall time of the analyzer over the whole
     repo, recorded in BENCH_r*.json so lint latency is a tracked metric —
@@ -1076,11 +1190,15 @@ def main(argv=None):
             print(json.dumps(bench_paged_attention(
                 smoke="--smoke" in args)))
             return
+        if leg == "prefix_cache":
+            print(json.dumps(bench_prefix_cache(smoke="--smoke" in args)))
+            return
         if leg == "analysis":
             print(json.dumps(bench_analysis(smoke="--smoke" in args)))
             return
         raise SystemExit(f"unknown bench leg: {leg!r} (available: "
-                         f"decode_attention, paged_attention, analysis)")
+                         f"decode_attention, paged_attention, prefix_cache, "
+                         f"analysis)")
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
     # the bench measures the scheduler as deployed.
     sys.setswitchinterval(0.001)

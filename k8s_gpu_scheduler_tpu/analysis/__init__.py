@@ -17,6 +17,10 @@ k8s_gpu_scheduler_tpu.analysis``; importable APIs below):
    donation contract (buffers actually consumed), with a pytest fixture
    (tests/conftest.py ``recompile_guard``) asserting steady-state decode
    never retraces.
+5. **Shared-page audit** (``alias``): the prefix cache's copy-on-write
+   rule — dispatches the real prefill/decode programs against pools with
+   declared shared pages and byte-verifies those pages came back
+   untouched (an aliased-page write is silent KV cross-contamination).
 
 Suppression: ``# graftcheck: ignore[rule]`` on the offending line, with a
 rationale in the surrounding comment (policy in README).
@@ -26,6 +30,7 @@ tier-1 gate (tests/test_graftcheck_clean.py) run only those; the traced
 passes add a few seconds and run in the full CLI and their own tests.
 """
 from .findings import ALL_RULES, Finding, Report, parse_suppressions
+from .alias import audit_shared_pages, check_shared_pages
 from .astlint import lint_source, run_astlint
 from .vmem import (
     VMEM_BYTES_PER_CORE, audit_vmem, decode_attention_footprint,
@@ -44,6 +49,8 @@ __all__ = [
     "decode_attention_footprint",
     "flash_attention_footprint",
     "paged_decode_attention_footprint",
+    "audit_shared_pages",
+    "check_shared_pages",
     "run_fast_passes",
     "run_traced_passes",
 ]
@@ -96,19 +103,22 @@ def _safe_entries(report: Report, src: str, attr: str, entries,
 
 
 def run_traced_passes(paths=None) -> Report:
-    """jaxpr audit + recompile/donation guard over the entry-point
-    registry, plus any ``GRAFTCHECK_JAXPR_AUDIT`` /
-    ``GRAFTCHECK_RECOMPILE_AUDIT`` hooks found in ``paths`` (how a seeded
+    """jaxpr audit + recompile/donation guard + shared-page (alias)
+    audit over the entry-point registry, plus any
+    ``GRAFTCHECK_JAXPR_AUDIT`` / ``GRAFTCHECK_RECOMPILE_AUDIT`` /
+    ``GRAFTCHECK_ALIAS_AUDIT`` hooks found in ``paths`` (how a seeded
     bad-fixture file, if it lands in the tree, gets caught)."""
     import time
 
     from . import entrypoints as eps
+    from .alias import audit_shared_pages
     from .jaxpr_audit import audit_callable
     from .recompile import audit_steady_state
 
     report = Report()
     hooks = list(_discover_hooks(
-        paths, ("GRAFTCHECK_JAXPR_AUDIT", "GRAFTCHECK_RECOMPILE_AUDIT")))
+        paths, ("GRAFTCHECK_JAXPR_AUDIT", "GRAFTCHECK_RECOMPILE_AUDIT",
+                "GRAFTCHECK_ALIAS_AUDIT")))
 
     t0 = time.perf_counter()
     for name, fn, args in eps.jaxpr_entrypoints():
@@ -132,6 +142,17 @@ def run_traced_passes(paths=None) -> Report:
             report.extend(audit_steady_state(build, name))
     report.extend(eps.donation_audit())
     report.pass_seconds["recompile"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for name, build in eps.alias_scenarios():
+        report.extend(audit_shared_pages(build, name))
+    for src, attr, entries in hooks:
+        if attr != "GRAFTCHECK_ALIAS_AUDIT":
+            continue
+        for entry in _safe_entries(report, src, attr, entries, arity=2):
+            name, build = entry
+            report.extend(audit_shared_pages(build, name))
+    report.pass_seconds["alias"] = time.perf_counter() - t0
     return report
 
 

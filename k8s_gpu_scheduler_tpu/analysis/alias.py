@@ -1,0 +1,97 @@
+"""Shared-page write audit — the prefix cache's copy-on-write rule with
+teeth.
+
+The radix prefix cache (models/prefix_cache.py) lets one physical KV
+page back the block tables of many slots at once; correctness rests on a
+single invariant the type system cannot see: **a shared page is never
+written**. The engine upholds it by construction (decode scatters at
+``lens`` which always points past the mounted prefix; the tail-prefill
+scatter receives only the slot's OWN page ids), but "by construction"
+is one refactor away from silent KV cross-contamination — the bug class
+where request B's system prompt suddenly contains request A's decode
+rows and every affected stream corrupts with no crash.
+
+This pass makes the invariant observable: a scenario declares which pool
+pages are shared, the audit snapshots those pages, dispatches the real
+jitted function once, and byte-compares the pages in the returned pool.
+Any difference is a ``shared-page-write`` finding (error severity).
+
+Scenario contract (``build()`` return value, also the
+``GRAFTCHECK_ALIAS_AUDIT`` hook protocol — a list of ``(name, build)``
+pairs):
+
+    (fn, args, pool_argnums, pool_outnums, shared_pages)
+
+``fn(*args)`` must return a tuple; ``pool_argnums[i]`` is the position
+of a pool operand in ``args`` and ``pool_outnums[i]`` the position of
+its updated value in the result; pools index pages on AXIS 1 (the
+``[L, n_pages, page_size, ...]`` serving layout). Inputs are snapshotted
+before the call, so donated pools are fine; callers pass throwaway
+engines/args like the donation audit does.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+from .findings import Finding
+
+
+def check_shared_pages(fn, args: tuple, pool_argnums: Sequence[int],
+                       pool_outnums: Sequence[int],
+                       shared: Sequence[int],
+                       name: str = "fn") -> List[Finding]:
+    """Dispatch ``fn(*args)`` once and verify every declared shared page
+    of every declared pool operand is byte-identical in the returned
+    pool. Shared page ids must be non-empty — a vacuous audit would read
+    as a clean COW bill of health while checking nothing."""
+    import numpy as np
+
+    anchor = f"<alias:{name}>"
+    shared = sorted(int(p) for p in shared)
+    if not shared:
+        return [Finding(
+            "alias-guard", anchor, 0,
+            f"{name}: no shared pages declared — the audit verified "
+            f"nothing")]
+    if len(pool_argnums) != len(pool_outnums):
+        return [Finding(
+            "alias-guard", anchor, 0,
+            f"{name}: {len(pool_argnums)} pool args vs "
+            f"{len(pool_outnums)} pool outputs")]
+    before = [np.array(np.asarray(args[i])[:, shared]) for i in pool_argnums]
+    out = fn(*args)
+    findings: List[Finding] = []
+    for argnum, outnum, snap in zip(pool_argnums, pool_outnums, before):
+        after = np.asarray(out[outnum])[:, shared]
+        if snap.shape != after.shape:
+            findings.append(Finding(
+                "alias-guard", anchor, 0,
+                f"{name}: pool arg {argnum} -> out {outnum} changed shape "
+                f"{snap.shape} -> {after.shape}"))
+            continue
+        changed = [p for j, p in enumerate(shared)
+                   if not np.array_equal(snap[:, j], after[:, j])]
+        if changed:
+            findings.append(Finding(
+                "shared-page-write", anchor, 0,
+                f"{name}: pool arg {argnum} WROTE shared page(s) "
+                f"{changed} — aliased prefix pages are read-only by the "
+                f"copy-on-write contract; a write corrupts every slot "
+                f"sharing them"))
+    return findings
+
+
+def audit_shared_pages(build: Callable[[], tuple],
+                       name: str) -> List[Finding]:
+    """Run one alias scenario from ``build`` (see the module docstring
+    for the contract). Exceptions become findings so a broken scenario
+    cannot mask the others — mirroring recompile.audit_steady_state."""
+    anchor = f"<alias:{name}>"
+    try:
+        fn, args, pool_argnums, pool_outnums, shared = build()
+        return check_shared_pages(fn, args, pool_argnums, pool_outnums,
+                                  shared, name=name)
+    except Exception as e:  # noqa: BLE001 — report, keep auditing
+        return [Finding("alias-guard", anchor, 0,
+                        f"scenario {name} failed to run: "
+                        f"{type(e).__name__}: {str(e)[:300]}")]

@@ -86,10 +86,21 @@ def jaxpr_entrypoints() -> List[Tuple[str, Callable, tuple]]:
         kv_layout="paged", page_size=8)
     pids = np.ones((2, 1), np.int32)                 # one 8-row page each
     tokens8 = np.zeros((2, 8), np.int32)             # tb page-rounded to 8
+    no_ptbl = np.zeros((2, 0), np.int32)             # hb=0: plain prefill
+    no_hits = np.zeros((2,), np.int32)
     entries.append((
         "batcher_prefill_paged", peng._prefill,
         (params, peng._k, peng._v, peng._ks, peng._vs, peng._lens,
-         peng._last, slots, pids, tokens8, lens, np.int32(1))))
+         peng._last, slots, pids, no_ptbl, no_hits, tokens8, lens,
+         np.int32(1))))
+    # Tail prefill with a prefix-cache hit (hb=1): the first 8 logical
+    # rows ride a shared page, only the tail prefills — the program the
+    # prefix cache's admission dispatches.
+    entries.append((
+        "batcher_prefill_paged_prefix", peng._prefill,
+        (params, peng._k, peng._v, peng._ks, peng._vs, peng._lens,
+         peng._last, slots, pids, np.full((2, 1), 2, np.int32),
+         np.full((2,), 8, np.int32), tokens8, lens, np.int32(1))))
     entries.append((
         "batcher_decode_paged", peng._decode,
         (params, peng._k, peng._v, peng._ks, peng._vs,
@@ -197,6 +208,52 @@ def _paged_batcher_scenario() -> tuple:
     return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
 
 
+def _paged_prefix_batcher_scenario() -> tuple:
+    """Prefix-cache edition of the paged scenario: every steady wave's
+    admissions HIT the radix cache (a shared 8-token system prefix the
+    warmup donated), so the dispatches are the tail-prefill program with
+    a mounted shared page plus decode chunks whose tables mix shared and
+    owned pages. By design still one compiled program per rung — hit
+    lengths, tables and tail tokens vary in CONTENT only — and the pool
+    keeps riding the donation chain."""
+    import dataclasses
+
+    from ..models.serving import ContinuousBatcher
+
+    cfg, params = _tiny()
+    eng = ContinuousBatcher(params, dataclasses.replace(cfg,
+                                                        decode_attn="fused"),
+                            n_slots=2, max_len=32, chunk=2,
+                            prefill_bucket=8, kv_dtype="int8",
+                            kv_layout="paged", page_size=8,
+                            prefix_cache=True)
+    rng = np.random.default_rng(0)
+    sys_prefix = list(rng.integers(0, cfg.vocab, 8))
+
+    def warmup():
+        # Miss rung (full prefill), then — after its reap donates the
+        # prefix page — the hit rung (tail prefill, hb=1).
+        eng.submit(sys_prefix + list(rng.integers(0, cfg.vocab, 5)),
+                   max_new=3)
+        eng.run()
+        eng.submit(sys_prefix + list(rng.integers(0, cfg.vocab, 5)),
+                   max_new=3)
+        eng.run()
+
+    def wave(suffix: int):
+        def go():
+            eng.submit(sys_prefix + list(rng.integers(0, cfg.vocab,
+                                                      suffix)), max_new=3)
+            eng.submit(sys_prefix + list(rng.integers(0, cfg.vocab,
+                                                      suffix - 1)),
+                       max_new=2)
+            eng.run()
+        return go
+
+    steady = [wave(4), wave(6), wave(8)]
+    return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
+
+
 def _generate_scenario() -> tuple:
     import jax
     import jax.numpy as jnp
@@ -220,6 +277,7 @@ def recompile_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
     return [
         ("batcher_steady_decode", _batcher_scenario),
         ("batcher_steady_decode_paged", _paged_batcher_scenario),
+        ("batcher_steady_decode_paged_prefix", _paged_prefix_batcher_scenario),
         ("generate_steady_state", _generate_scenario),
     ]
 
@@ -263,6 +321,23 @@ def donation_audit() -> List:
                                donated=(1, 2, 3, 4, 5),
                                name="batcher_decode_paged")
 
+    # Tail prefill (prefix-cache hit shape): the pool + scale planes must
+    # donate through the hb>0 program too — a copy here would double the
+    # pool's HBM on every admission with a hit.
+    peng2 = ContinuousBatcher(params, cfg, n_slots=2, max_len=32, chunk=2,
+                              prefill_bucket=4, kv_dtype="int8",
+                              kv_layout="paged", page_size=8,
+                              prefix_cache=True)
+    slots = np.zeros((2,), np.int32)
+    pxargs = (params, peng2._k, peng2._v, peng2._ks, peng2._vs,
+              peng2._lens, peng2._last, slots, np.ones((2, 1), np.int32),
+              np.full((2, 1), 2, np.int32), np.full((2,), 8, np.int32),
+              np.zeros((2, 8), np.int32), np.full((2,), 4, np.int32),
+              np.int32(1))
+    findings += check_donation(peng2._prefill, *pxargs,
+                               donated=(1, 2, 3, 4),
+                               name="batcher_prefill_paged_prefix")
+
     opt = optax.adamw(1e-3)
     state = jax.jit(opt.init)(params)
     step = make_train_step(cfg, None, opt)
@@ -274,3 +349,73 @@ def donation_audit() -> List:
         step, (params, state, batch), jax.tree.leaves((params, state)),
         name="llama_train_step")
     return findings
+
+
+# -- shared-page (copy-on-write) scenarios ------------------------------------
+
+def _prefix_engine():
+    """A warmed prefix-cache engine with one donated prefix page and a
+    live request MOUNTING it: the state both alias scenarios audit
+    against. Returns (engine, shared page ids)."""
+    import dataclasses
+
+    from ..models.serving import ContinuousBatcher
+
+    cfg, params = _tiny()
+    eng = ContinuousBatcher(params, dataclasses.replace(cfg,
+                                                        decode_attn="fused"),
+                            n_slots=2, max_len=32, chunk=2,
+                            prefill_bucket=8, kv_dtype="int8",
+                            kv_layout="paged", page_size=8,
+                            prefix_cache=True)
+    rng = np.random.default_rng(0)
+    sys_prefix = list(rng.integers(0, cfg.vocab, 8))
+    eng.submit(sys_prefix + list(rng.integers(0, cfg.vocab, 3)), max_new=2)
+    eng.run()                        # reap donates the sys-prefix page
+    # A live request mounted on the shared page, mid-decode.
+    eng.submit(sys_prefix + list(rng.integers(0, cfg.vocab, 4)), max_new=9)
+    eng.step()
+    shared = sorted({p for pages in eng._slot_shared.values()
+                     for p in pages})
+    assert shared, "scenario must actually share a page"
+    return eng, shared
+
+
+def _alias_prefill_scenario() -> tuple:
+    """The tail-prefill dispatch with a mounted shared prefix page: its
+    page-granular scatter must touch only the entry's OWN pages."""
+    eng, shared = _prefix_engine()
+    own = eng._alloc.alloc(1)        # a throwaway tail page to scatter to
+    eng._alloc.retain(shared)        # mirror admission's mount
+    rng = np.random.default_rng(1)
+    args = (eng.params, eng._k, eng._v, eng._ks, eng._vs, eng._lens,
+            eng._last, np.ones((2,), np.int32),
+            np.full((2, 1), own[0], np.int32),
+            np.asarray([[shared[0]]] * 2, np.int32),
+            np.full((2,), 8, np.int32),
+            np.asarray([list(rng.integers(0, 256, 8))] * 2, np.int32),
+            np.full((2,), 4, np.int32), np.int32(99))
+    # _prefill returns (k, v, k_s, v_s, lens, last, firsts).
+    return eng._prefill, args, (1, 2, 3, 4), (0, 1, 2, 3), shared
+
+
+def _alias_decode_scenario() -> tuple:
+    """A decode chunk over a block table whose prefix rows are shared:
+    the per-slot scatter at ``lens`` must land past the mounted prefix,
+    never inside it."""
+    eng, shared = _prefix_engine()
+    args = (eng.params, eng._k, eng._v, eng._ks, eng._vs,
+            eng._table_np.copy(), eng._lens, eng._last,
+            np.asarray([s in eng._slot_req for s in range(eng.n_slots)]),
+            np.int32(99))
+    # _decode returns (k, v, k_s, v_s, table, lens, last, toks).
+    return eng._decode, args, (1, 2, 3, 4), (0, 1, 2, 3), shared
+
+
+def alias_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
+    """(name, build) pairs for the shared-page audit (analysis/alias.py):
+    every real program that runs with aliased prefix pages in its pool."""
+    return [
+        ("batcher_prefill_paged_prefix", _alias_prefill_scenario),
+        ("batcher_decode_paged_prefix", _alias_decode_scenario),
+    ]

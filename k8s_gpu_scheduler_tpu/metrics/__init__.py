@@ -14,7 +14,10 @@ from .client import (
     TPU_SERIES,
     parse_response,
 )
-from .exporter import Counter, Gauge, Histogram, MetricsServer, Registry
+from .exporter import (
+    Counter, Gauge, Histogram, MetricsServer, Registry,
+    SERVING_POOL_GAUGES, export_serving_pool,
+)
 
 __all__ = [
     "HBM_BANDWIDTH_UTIL",
@@ -32,4 +35,6 @@ __all__ = [
     "Histogram",
     "MetricsServer",
     "Registry",
+    "SERVING_POOL_GAUGES",
+    "export_serving_pool",
 ]

@@ -173,6 +173,45 @@ class Registry:
         return "\n".join(lines) + "\n"
 
 
+# Serving page-pool / prefix-cache gauges (ContinuousBatcher.pool_metrics
+# key -> help text). Published via export_serving_pool below so the pool
+# numbers that previously lived only in pool_metrics()/bench ride the same
+# /metrics endpoint the scheduler's own latency histograms use.
+SERVING_POOL_GAUGES = {
+    "pages_total": "usable KV pages in the serving pool",
+    "pages_free": "KV pages currently on the free list",
+    "pages_in_use": "KV pages with at least one live reference",
+    "pages_cached": "KV pages held (possibly shared) by the prefix tree",
+    "pages_watermark": "high-water mark of referenced KV pages",
+    "page_allocs": "cumulative page allocations",
+    "page_frees": "cumulative page reference drops",
+    "page_denied": "admissions denied for lack of free pages",
+    "page_utilization": "referenced pages / usable pool (instantaneous)",
+    "prefix_cached_pages": "pages (= radix-tree nodes) in the prefix cache",
+    "prefix_hit_rate": "token-weighted prefix-cache hit rate",
+    "prefix_request_hit_rate": "fraction of lookups matching any prefix",
+    "prefix_hit_tokens": "cumulative prompt tokens served from the cache",
+    "prefix_lookup_tokens": "cumulative prompt tokens looked up",
+    "prefix_lookups": "cumulative prefix-cache lookups (admissions)",
+    "prefix_lookup_hits": "cumulative lookups that matched any prefix",
+    "prefix_inserted_pages": "cumulative pages adopted into the tree",
+    "prefix_evictions": "cumulative prefix-cache pages evicted (LRU)",
+    "prefill_tokens_skipped": "prefill rows skipped via prefix reuse",
+}
+
+
+def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
+                        prefix: str = "tpu_serve_") -> None:
+    """Publish a ``ContinuousBatcher.pool_metrics()`` snapshot as gauges
+    (``tpu_serve_page_utilization``, ``tpu_serve_prefix_hit_rate``, ...).
+    Keys absent from the snapshot (contiguous layout → {}, prefix cache
+    off → no prefix_* keys) are simply skipped, so callers can publish
+    unconditionally on every scrape/step."""
+    for key, help_ in SERVING_POOL_GAUGES.items():
+        if key in pool_metrics:
+            registry.gauge(prefix + key, help_).set(pool_metrics[key])
+
+
 class MetricsServer:
     """Serves a Registry at /metrics (Prometheus text exposition)."""
 

@@ -18,6 +18,7 @@ from .serving import (
     init_cache, make_server_step, make_speculative_server_step,
 )
 from .paging import PageAllocator
+from .prefix_cache import PrefixCache
 from .pipeline import make_pp_train_step, pp_loss_fn
 
 __all__ = [
@@ -38,6 +39,7 @@ __all__ = [
     "make_speculative_server_step",
     "ContinuousBatcher",
     "PageAllocator",
+    "PrefixCache",
     "make_pp_train_step",
     "pp_loss_fn",
 ]

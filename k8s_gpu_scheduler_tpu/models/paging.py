@@ -5,10 +5,19 @@ scalar cursor with a pool of fixed-size KV pages and a per-slot block
 table: admission needs FREE PAGES, not a contiguous window, so a prompt
 admits the moment enough requests have finished — no backward-write
 trick, no epoch roll, no all-slots-drained idle boundary. This module is
-the allocator half of that design: a plain LIFO free list (recently
-freed pages are re-written soonest — friendliest to whatever HBM pages
-are still warm) with watermark/churn metrics the bench and the serving
+the allocator half of that design: a LIFO free list (recently freed
+pages are re-written soonest — friendliest to whatever HBM pages are
+still warm) with watermark/churn metrics the bench and the serving
 entrypoint publish.
+
+Since the prefix cache landed (models/prefix_cache.py) pages are
+REF-COUNTED: one physical page can back the block tables of many slots
+at once (a shared system-prompt prefix) plus a reference held by the
+radix tree itself. ``alloc`` hands out pages at refcount 1, ``retain``
+adds a holder, ``free`` drops one — a page returns to the free list only
+when its LAST reference drops. The tree's reference is labeled via
+``adopt``/``drop_cached`` so the pool partitions cleanly into
+free / held / cached for the ``assert_consistent`` invariant check.
 
 Page 0 is RESERVED as the null/scratch page: device-side writes for
 inactive slots and the over-provisioned tail of a padded prefill scatter
@@ -28,7 +37,7 @@ here.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional, Set
 
 NULL_PAGE = 0
 
@@ -46,7 +55,8 @@ class PageAllocator:
         self.n_pages = n_pages
         # LIFO: freed pages are reused first.
         self._free: List[int] = list(range(n_pages - 1, NULL_PAGE, -1))
-        self._held: set = set()              # pages currently allocated
+        self._ref: Dict[int, int] = {}       # page -> live reference count
+        self._cached: Set[int] = set()       # pages the prefix tree holds
         self._watermark = 0
         self._allocs = 0
         self._frees = 0
@@ -58,16 +68,21 @@ class PageAllocator:
 
     @property
     def in_use(self) -> int:
-        return len(self._held)
+        return len(self._ref)
+
+    def ref(self, page: int) -> int:
+        """Live reference count of ``page`` (0 when free)."""
+        return self._ref.get(page, 0)
 
     def alloc(self, n: int,
               count_denied: bool = True) -> Optional[List[int]]:
-        """n pages, or None when fewer than n are free (all-or-nothing —
-        a partial grant could deadlock two admissions against each
-        other). ``count_denied=False`` suppresses the denial counter for
-        RETRIES of an already-counted request — the batcher re-attempts
-        its blocked queue head every decode step, and counting each
-        retry would report a thousand denials for one waiting request."""
+        """n pages at refcount 1, or None when fewer than n are free
+        (all-or-nothing — a partial grant could deadlock two admissions
+        against each other). ``count_denied=False`` suppresses the denial
+        counter for RETRIES of an already-counted request — the batcher
+        re-attempts its blocked queue head every decode step, and counting
+        each retry would report a thousand denials for one waiting
+        request."""
         if n < 0:
             raise ValueError(f"negative page count {n}")
         if n > len(self._free):
@@ -77,41 +92,132 @@ class PageAllocator:
         pages, self._free = self._free[len(self._free) - n:], \
             self._free[:len(self._free) - n]
         pages.reverse()                      # LIFO pop order, stable ids
-        self._held.update(pages)
-        self._watermark = max(self._watermark, len(self._held))
+        for p in pages:
+            self._ref[p] = 1
+        self._watermark = max(self._watermark, len(self._ref))
         self._allocs += n
         return pages
 
-    def free(self, pages: List[int]) -> None:
-        """Return pages to the pool. Per-page validated BEFORE any state
+    def retain(self, pages: Iterable[int]) -> None:
+        """Add one reference per page — how a slot's block table comes to
+        share a cached prefix page. Validated BEFORE any state mutates:
+        retaining a free (or null) page would resurrect a buffer another
+        request is about to overwrite."""
+        pages = list(pages)
+        for p in pages:
+            if p == NULL_PAGE:
+                raise ValueError("cannot retain the reserved null page")
+            if p not in self._ref:
+                raise RuntimeError(
+                    f"retain of free/foreign page {p}: only allocated "
+                    f"pages can gain references")
+        for p in pages:
+            self._ref[p] += 1
+
+    def free(self, pages: Iterable[int]) -> None:
+        """Drop one reference per page; a page returns to the pool when
+        its LAST reference drops. Per-page validated BEFORE any state
         mutates: a double free (or freeing a page this allocator never
         handed out) would put the same id on the free list twice, handing
         one physical page to two future requests — silent KV
-        cross-contamination, the worst possible failure mode."""
+        cross-contamination, the worst possible failure mode. A page
+        whose only remaining reference is the prefix tree's must be
+        released via ``drop_cached`` (eviction), never ``free`` — hitting
+        that here means slot bookkeeping leaked a tree reference."""
+        pages = list(pages)
         for p in pages:
             if p == NULL_PAGE:
                 raise ValueError("cannot free the reserved null page")
-            if p not in self._held:
+            if p not in self._ref:
                 raise RuntimeError(
                     f"double free (or foreign page): page {p} is not "
                     f"currently allocated")
+        drops: Dict[int, int] = {}
         for p in pages:
-            self._held.discard(p)
-            self._free.append(p)
+            drops[p] = drops.get(p, 0) + 1
+        for p, n in drops.items():
+            if self._ref[p] < n:
+                raise RuntimeError(
+                    f"double free: page {p} freed {n}x with only "
+                    f"{self._ref[p]} live reference(s)")
+            if self._ref[p] == n and p in self._cached:
+                raise RuntimeError(
+                    f"page {p} is still cached by the prefix tree — its "
+                    f"tree reference must drop via drop_cached (eviction), "
+                    f"not free")
+        for p in pages:
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                del self._ref[p]
+                self._free.append(p)
         self._frees += len(pages)
+
+    def adopt(self, pages: Iterable[int]) -> None:
+        """Re-label one existing reference per page as the prefix tree's
+        (donation: the reaped slot's reference transfers to the tree, so
+        counts don't change — only the ``cached`` partition does)."""
+        pages = list(pages)
+        for p in pages:
+            if p not in self._ref:
+                raise RuntimeError(f"adopt of free/foreign page {p}")
+            if p in self._cached:
+                raise RuntimeError(f"page {p} is already cached")
+        self._cached.update(pages)
+
+    def drop_cached(self, page: int) -> None:
+        """Eviction: drop the prefix tree's reference on ``page``. The
+        page returns to the free list iff no slot still shares it."""
+        if page not in self._cached:
+            raise RuntimeError(f"page {page} is not cached")
+        self._cached.discard(page)
+        self.free([page])
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._cached)
+
+    def assert_consistent(self) -> None:
+        """Invariant check for tests and the bench leg: the free list,
+        the slot-held pages and the tree-cached pages PARTITION the usable
+        pool — no page in two buckets, none missing, no duplicate free
+        entries, no zero/negative refcounts, the null page in none of
+        them. Raises RuntimeError on the first violation."""
+        usable = set(range(1, self.n_pages))
+        free = list(self._free)
+        if len(free) != len(set(free)):
+            raise RuntimeError(f"free list holds duplicates: {sorted(free)}")
+        free_s = set(free)
+        held = set(self._ref)
+        if NULL_PAGE in free_s or NULL_PAGE in held:
+            raise RuntimeError("null page leaked into the pool bookkeeping")
+        if free_s & held:
+            raise RuntimeError(
+                f"pages both free and allocated: {sorted(free_s & held)}")
+        if free_s | held != usable:
+            raise RuntimeError(
+                f"pool not covered: missing {sorted(usable - free_s - held)}"
+                f", foreign {sorted((free_s | held) - usable)}")
+        bad_refs = {p: c for p, c in self._ref.items() if c < 1}
+        if bad_refs:
+            raise RuntimeError(f"non-positive refcounts: {bad_refs}")
+        if not self._cached <= held:
+            raise RuntimeError(
+                f"cached pages not allocated: "
+                f"{sorted(self._cached - held)}")
 
     def metrics(self) -> Dict[str, float]:
         """Allocator state for the bench/Observation publishers. The
-        utilization is instantaneous (pages now held / usable pool);
+        utilization is instantaneous (pages now referenced / usable pool);
         the watermark is the high-water mark since construction."""
         usable = self.n_pages - 1
         return {
             "pages_total": float(usable),
             "pages_free": float(len(self._free)),
-            "pages_in_use": float(len(self._held)),
+            "pages_in_use": float(len(self._ref)),
+            "pages_cached": float(len(self._cached)),
             "pages_watermark": float(self._watermark),
             "page_allocs": float(self._allocs),
             "page_frees": float(self._frees),
             "page_denied": float(self._denied),
-            "page_utilization": (len(self._held) / usable) if usable else 0.0,
+            "page_utilization": (len(self._ref) / usable) if usable else 0.0,
         }

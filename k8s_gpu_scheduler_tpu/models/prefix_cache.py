@@ -1,0 +1,203 @@
+"""Radix prefix cache over the paged KV pool — cross-request KV reuse.
+
+The paged ContinuousBatcher (models/serving.py) already stores K/V in
+fixed-size pages addressed through per-slot block tables, which is
+exactly the representation block-granular sharing needs (vLLM's
+PagedAttention insight): a physical page holding the KV of a token chunk
+can back ANY slot whose prompt starts with those tokens. This module is
+the host-side index that finds such pages (SGLang's RadixAttention idea,
+page-granular): a radix tree keyed on ``page_size``-token chunks of
+token ids, each node owning ONE physical page whose KV rows are the
+prefill of that chunk **in the context of the path above it** — so a
+root-to-node path spells a prompt prefix and the pages along it are its
+complete KV.
+
+The contract with the pool (models/paging.py) is reference counting:
+
+- every node's page carries the TREE's reference (``PageAllocator.
+  adopt``); a ``match`` winner additionally gains one reference per slot
+  that mounts it (``retain``), dropped at reap (``free``).
+- cached pages are READ-ONLY by construction: a matched prefix is always
+  page-aligned and always leaves at least the prompt's last token to
+  prefill, so the slot's own writes (the partial last prompt page, every
+  decode row) land in freshly-owned pages — copy-on-write at page
+  granularity, with nothing ever actually copied.
+- eviction (``evict``) removes only LEAVES whose page has no holder but
+  the tree (refcount 1), oldest ``last_used`` first — LRU over complete
+  suffixes, so an evicted path can never strand a child whose KV depends
+  on it.
+
+Insertion is donation, not copying: when a request is reaped, the pages
+covering its FULL prompt chunks transfer into the tree where the path
+does not exist yet (the slot's reference is re-labeled as the tree's),
+and duplicate chunks — the hit path it was mounted on, or a path a
+concurrent request donated first — stay with the caller to release.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .paging import PageAllocator
+
+
+class _Node:
+    """One cached page: ``chunk`` (page_size token ids) under its parent,
+    holding physical page ``page``. The root is a chunk-less sentinel."""
+
+    __slots__ = ("chunk", "page", "parent", "children", "last_used")
+
+    def __init__(self, chunk: Optional[Tuple[int, ...]], page: Optional[int],
+                 parent: Optional["_Node"]) -> None:
+        self.chunk = chunk
+        self.page = page
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.last_used = 0
+
+
+class PrefixCache:
+    """Page-granular radix tree of cached prompt prefixes over a
+    ref-counted ``PageAllocator``. Purely host-side: it stores token
+    chunks and page IDS — the KV bytes never leave the device pool."""
+
+    def __init__(self, allocator: PageAllocator, page_size: int) -> None:
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self._alloc = allocator
+        self.page_size = page_size
+        self._root = _Node(None, None, None)
+        self._clock = 0                      # logical LRU time
+        self._n_nodes = 0
+        # Aggregate counters for pool_metrics()/the bench leg.
+        self._lookups = 0                    # match() calls
+        self._lookup_hits = 0                # match() calls with >= 1 page
+        self._lookup_tokens = 0              # prompt tokens seen by match()
+        self._hit_tokens = 0                 # tokens covered by matches
+        self._inserted_pages = 0             # pages adopted into the tree
+        self._evictions = 0                  # pages evicted (LRU)
+
+    def __len__(self) -> int:
+        """Number of cached pages (== tree nodes, one page per node)."""
+        return self._n_nodes
+
+    def _chunks(self, tokens: Sequence[int]) -> List[Tuple[int, ...]]:
+        """The FULL page_size-token chunks of ``tokens`` (the trailing
+        partial chunk is never cacheable — it shares a page with rows the
+        owning request keeps writing)."""
+        ps = self.page_size
+        return [tuple(int(t) for t in tokens[i:i + ps])
+                for i in range(0, (len(tokens) // ps) * ps, ps)]
+
+    def match(self, tokens: Sequence[int],
+              count: bool = True) -> List[int]:
+        """Longest cached page-aligned prefix of ``tokens``: the page ids
+        of the matched path, shallowest first. Capped so at least ONE
+        prompt token is left to prefill — the admission needs the
+        last-position logits to sample the first output token, so a fully
+        cached prompt still re-prefills its final page. Touches the
+        matched path's LRU clocks; takes NO references (the caller
+        retains what it actually mounts). ``count=False`` suppresses the
+        hit/lookup counters for RETRIES of a page-blocked queue head —
+        the batcher re-matches it every decode step, and counting each
+        retry would let one waiting request swamp the hit rate."""
+        self._clock += 1
+        chunks = self._chunks(tokens)
+        if chunks and len(chunks) * self.page_size == len(tokens):
+            chunks = chunks[:-1]             # leave the last token's page
+        node, pages = self._root, []
+        for chunk in chunks:
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            child.last_used = self._clock
+            pages.append(child.page)
+            node = child
+        if count:
+            self._lookups += 1
+            self._lookup_tokens += len(tokens)
+            self._hit_tokens += len(pages) * self.page_size
+            if pages:
+                self._lookup_hits += 1
+        return pages
+
+    def insert(self, tokens: Sequence[int],
+               pages: Sequence[int]) -> List[int]:
+        """Donate ``pages[i]`` as the cached KV of the i-th full chunk of
+        ``tokens`` (the reaped request's block-table prefix, shared hit
+        pages included). Returns the pages the tree ADOPTED (their
+        reference now belongs to the tree); every other page — chunks
+        already cached, by this request's own hit path or by a concurrent
+        donor — stays with the caller, which must ``free`` its reference
+        as usual. Raises if ``pages`` is shorter than the chunk walk it
+        must cover."""
+        self._clock += 1
+        chunks = self._chunks(tokens)
+        if len(pages) < len(chunks):
+            raise ValueError(
+                f"{len(chunks)} full chunks but only {len(pages)} pages")
+        node, adopted = self._root, []
+        for chunk, page in zip(chunks, pages):
+            child = node.children.get(chunk)
+            if child is None:
+                self._alloc.adopt([page])
+                child = _Node(chunk, int(page), node)
+                node.children[chunk] = child
+                self._n_nodes += 1
+                self._inserted_pages += 1
+                adopted.append(int(page))
+            child.last_used = self._clock
+            node = child
+        return adopted
+
+    def _evictable_leaves(self) -> List[_Node]:
+        out, stack = [], [self._root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if (node is not self._root and not node.children
+                    and self._alloc.ref(node.page) == 1):
+                out.append(node)
+        return out
+
+    def evict(self, n_pages: int) -> int:
+        """Free up to ``n_pages`` cached pages, least-recently-used leaf
+        first. Only leaves whose page no slot shares (tree refcount the
+        sole holder) are candidates; evicting a leaf can expose its
+        parent, so the sweep re-collects until satisfied or dry. Returns
+        the number of pages actually freed."""
+        freed = 0
+        while freed < n_pages:
+            leaves = self._evictable_leaves()
+            if not leaves:
+                break
+            leaves.sort(key=lambda n: n.last_used)
+            for leaf in leaves:
+                if freed >= n_pages:
+                    break
+                del leaf.parent.children[leaf.chunk]
+                self._alloc.drop_cached(leaf.page)
+                self._n_nodes -= 1
+                self._evictions += 1
+                freed += 1
+        return freed
+
+    def metrics(self) -> Dict[str, float]:
+        """Prefix-reuse counters for pool_metrics()/the exporter: token
+        and request hit rates, cached-page count, adoption/eviction
+        churn. ``prefix_hit_rate`` is token-weighted (cached tokens /
+        prompt tokens looked up) — the number that predicts prefill FLOPs
+        saved; ``prefix_request_hit_rate`` is the fraction of lookups
+        that matched at all."""
+        return {
+            "prefix_cached_pages": float(self._n_nodes),
+            "prefix_lookups": float(self._lookups),
+            "prefix_lookup_hits": float(self._lookup_hits),
+            "prefix_lookup_tokens": float(self._lookup_tokens),
+            "prefix_hit_tokens": float(self._hit_tokens),
+            "prefix_hit_rate": (self._hit_tokens / self._lookup_tokens
+                                if self._lookup_tokens else 0.0),
+            "prefix_request_hit_rate": (self._lookup_hits / self._lookups
+                                        if self._lookups else 0.0),
+            "prefix_inserted_pages": float(self._inserted_pages),
+            "prefix_evictions": float(self._evictions),
+        }

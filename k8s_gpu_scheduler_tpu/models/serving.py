@@ -30,8 +30,13 @@ int8 weights via ops/quant.py) with TWO cache layouts — the contiguous
 shared-cursor cache and a vLLM-style PAGED cache (``kv_layout="paged"``:
 fixed-size page pool + per-slot block tables + models/paging.py's host
 allocator; no admission contiguity constraint, no epoch roll, block
-tables ride the fused kernel as a scalar-prefetch operand) — and
-``generate_speculative`` (prompt-lookup speculation, draft-model-free).
+tables ride the fused kernel as a scalar-prefetch operand), a
+SHARED-PREFIX radix cache over the paged pool (``prefix_cache=True``,
+models/prefix_cache.py: reaped prompts donate their full pages into a
+token-chunk tree, admission mounts the longest cached prefix read-only
+and prefills only the novel tail — ref-counted pages, copy-on-write at
+page granularity, LRU eviction) — and ``generate_speculative``
+(prompt-lookup speculation, draft-model-free).
 
 The reference has no serving engine at all (it schedules inference pods,
 SURVEY.md §0); this is the workload side of BASELINE config 5
@@ -58,6 +63,7 @@ from ..ops.layers import apply_rope, rms_norm, rope_freqs
 from ..ops.quant import qdot
 from .llama import LlamaConfig, _constrain, mlp_sublayer
 from .paging import NULL_PAGE, PageAllocator
+from .prefix_cache import PrefixCache
 
 _NEG_INF = -1e30
 
@@ -671,8 +677,9 @@ def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
 
 
 def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
-                            k, v, lens, last, slots, page_ids, tokens,
-                            real_lens, seed, temperature: float = 0.0,
+                            k, v, lens, last, slots, page_ids,
+                            prefix_tables, hit_lens, tokens, tail_lens,
+                            seed, temperature: float = 0.0,
                             top_k: int = 0, k_s=None, v_s=None):
     """Prefill M freed slots from right-padded prompts [M, tb] in ONE
     dispatch, paged edition: the batched mini cache computes every
@@ -683,22 +690,109 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
     (bucket tb can overshoot the rows the request will ever own). Pad
     entries repeat a REAL entry, so duplicate page ids carry identical
     values and the scatter stays idempotent, mirroring the contiguous
-    path's padding contract. Only ``real_len`` logical rows become
-    attendable (lens is set to real_len); the garbage the padded tail
-    writes inside the last page sits above lens until the slot's own
-    decode steps overwrite it."""
+    path's padding contract. Only ``tail_len`` logical rows become
+    attendable (lens is set to hit_len + tail_len); the garbage the
+    padded tail writes inside the last page sits above lens until the
+    slot's own decode steps overwrite it.
+
+    PREFIX-CACHE tail prefill: when ``prefix_tables`` [M, hb] is
+    non-empty (hb > 0, a trace-time branch — the hb == 0 program is the
+    plain path, unchanged), ``tokens`` holds only the UNCACHED TAIL of
+    each prompt: the first ``hit_len`` rows of the slot already live in
+    shared read-only pages (the radix prefix cache's match,
+    models/prefix_cache.py), listed in ``prefix_tables`` (null-padded to
+    the hb bucket). The tail's queries attend the gathered prefix K/V
+    (dequantized from the pool in int8 mode — the SAME values decode
+    reads) plus themselves causally at absolute positions hit_len..
+    hit_len+tb-1, so prefill FLOPs and pool writes scale with the NOVEL
+    suffix; the scatter targets only the entry's own pages — shared
+    pages are never written (copy-on-write at page granularity, enforced
+    by the graftcheck shared-page audit).
+
+    Parity note: the cached prefix holds exactly the bytes this
+    request's own prefill would have written (prefill KV of a prefix is
+    a deterministic function of the prefix tokens), so in bf16/f32 mode
+    the only cache-on/off divergence is float reduction order — the same
+    noise class as dense-vs-fused, which the token-identity suites
+    already absorb. In int8-KV mode there is one real numeric delta:
+    these tail queries attend the DEQUANTIZED prefix (what decode also
+    attends) where the cache-off full prefill attends its pre-
+    quantization bf16 mini cache — greedy argmax only flips on a
+    near-exact logit tie, and the parity tests pin it, but it is
+    quantization-noise-bounded rather than structural."""
     quant = k_s is not None
     B = last.shape[0]
     M, tb = tokens.shape
     npg = page_ids.shape[1]
-    mini = {
-        "k": jnp.zeros((cfg.n_layers, M, tb, cfg.n_kv_heads, cfg.head_dim),
-                       cfg.dtype),
-        "v": jnp.zeros((cfg.n_layers, M, tb, cfg.n_kv_heads, cfg.head_dim),
-                       cfg.dtype),
-        "len": jnp.zeros((), jnp.int32),
-    }
-    logits, mini = forward_with_cache(params, tokens, cfg, mini, mesh=None)
+    hb = prefix_tables.shape[1]
+    if hb == 0:
+        # Plain path: tokens are whole prompts, nothing cached.
+        mini = {
+            "k": jnp.zeros((cfg.n_layers, M, tb, cfg.n_kv_heads,
+                            cfg.head_dim), cfg.dtype),
+            "v": jnp.zeros((cfg.n_layers, M, tb, cfg.n_kv_heads,
+                            cfg.head_dim), cfg.dtype),
+            "len": jnp.zeros((), jnp.int32),
+        }
+        logits, mini = forward_with_cache(params, tokens, cfg, mini,
+                                          mesh=None)
+        mk, mv = mini["k"], mini["v"]
+    else:
+        hp = hb * page_size
+        g = cfg.n_heads // cfg.n_kv_heads
+        scale = 1.0 / (cfg.head_dim ** 0.5)
+
+        def gather_prefix(pool):
+            # [L, n_pages, ps, Hkv, x] -> [L, M, hb*ps, Hkv, x]
+            got = pool[:, prefix_tables]         # [L, M, hb, ps, Hkv, x]
+            return got.reshape(pool.shape[0], M, hp, *pool.shape[3:])
+
+        if quant:
+            pk = (gather_prefix(k).astype(jnp.float32)
+                  * gather_prefix(k_s)).astype(cfg.dtype)
+            pv = (gather_prefix(v).astype(jnp.float32)
+                  * gather_prefix(v_s)).astype(cfg.dtype)
+        else:
+            pk, pv = gather_prefix(k), gather_prefix(v)
+        # Per-entry absolute positions: tail row i sits at hit_len + i
+        # (clamped — the bucket's padded tail may overshoot the rope
+        # table; those rows are never attended).
+        pos_q = hit_lens[:, None] + jnp.arange(tb)[None, :]     # [M, tb]
+        angles = rope_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta)[
+            jnp.minimum(pos_q, cfg.max_seq - 1)]                # [M,tb,hd/2]
+        x = params["embed"][tokens].astype(cfg.dtype)
+        kcol = jnp.arange(hp + tb)[None, None, :]
+        # Prefix col c valid iff c < hit_len; tail col hp+j causal within
+        # the window (query i attends tail rows j <= i).
+        valid = jnp.where(
+            kcol < hp, kcol < hit_lens[:, None, None],
+            (kcol - hp) <= jnp.arange(tb)[None, :, None])       # [M,tb,K]
+
+        def block(x, layer):
+            blk, pk_l, pv_l = layer              # prefix K/V [M, hp, Hkv, hd]
+            h = rms_norm(x, blk["attn_norm"])
+            q = qdot(h, blk["wq"]).reshape(M, tb, cfg.n_heads, cfg.head_dim)
+            kk = qdot(h, blk["wk"]).reshape(M, tb, cfg.n_kv_heads,
+                                            cfg.head_dim)
+            vv = qdot(h, blk["wv"]).reshape(M, tb, cfg.n_kv_heads,
+                                            cfg.head_dim)
+            q, kk = apply_rope(q, angles), apply_rope(kk, angles)
+            qg = q.reshape(M, tb, cfg.n_kv_heads, g, cfg.head_dim)
+            kf = jnp.concatenate([pk_l, kk], axis=1)   # [M, hp+tb, Hkv, hd]
+            vf = jnp.concatenate([pv_l, vv], axis=1)
+            scores = jnp.einsum(
+                "bqhgd,bkhd->bhgqk", qg, kf).astype(jnp.float32) * scale
+            scores = jnp.where(valid[:, None, None], scores, _NEG_INF)
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
+            x = x + qdot(attn.reshape(M, tb, cfg.n_heads * cfg.head_dim),
+                         blk["wo"])
+            x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
+            return x, (kk, vv)
+
+        x, (mk, mv) = jax.lax.scan(block, x, (params["blocks"], pk, pv))
+        x = rms_norm(x, params["final_norm"])
+        logits = qdot(x, params["lm_head"]).astype(jnp.float32)
 
     def page_blocks(a):
         # [L, M, tb, Hkv, x] -> [L, M*npg, ps, Hkv, x] page-granular blocks
@@ -706,28 +800,28 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
 
     ids = page_ids.reshape(M * npg)
     if quant:
-        mkq, mks = _kv_quant(mini["k"])
-        mvq, mvs = _kv_quant(mini["v"])
+        mkq, mks = _kv_quant(mk)
+        mvq, mvs = _kv_quant(mv)
         k = k.at[:, ids].set(page_blocks(mkq))
         v = v.at[:, ids].set(page_blocks(mvq))
         k_s = k_s.at[:, ids].set(page_blocks(mks))
         v_s = v_s.at[:, ids].set(page_blocks(mvs))
     else:
-        k = k.at[:, ids].set(page_blocks(mini["k"]))
-        v = v.at[:, ids].set(page_blocks(mini["v"]))
+        k = k.at[:, ids].set(page_blocks(mk))
+        v = v.at[:, ids].set(page_blocks(mv))
     row_ids = jnp.arange(B)
     base_key = jax.random.fold_in(jax.random.PRNGKey(1), seed)
     firsts = []
     for i in range(M):                               # static unroll
-        slot, real_len = slots[i], real_lens[i]
+        slot, tail_len = slots[i], tail_lens[i]
         is_slot = row_ids == slot
         # Key by SLOT (see _prefill_multi_fn): pad rows duplicate a real
         # entry and must re-draw the same token.
         first = _sample_tokens(
-            logits[i, real_len - 1], jax.random.fold_in(base_key, slot),
+            logits[i, tail_len - 1], jax.random.fold_in(base_key, slot),
             temperature, top_k,
         ).astype(last.dtype)
-        lens = jnp.where(is_slot, real_len, lens)
+        lens = jnp.where(is_slot, hit_lens[i] + tail_len, lens)
         last = jnp.where(is_slot, first, last)
         firsts.append(first)
     return k, v, k_s, v_s, lens, last, jnp.stack(firsts)
@@ -752,7 +846,8 @@ class ContinuousBatcher:
                  top_k: int = 0, kv_dtype: Optional[str] = None,
                  kv_layout: str = "contiguous",
                  page_size: int = DEFAULT_PAGE_SIZE,
-                 n_pages: Optional[int] = None):
+                 n_pages: Optional[int] = None,
+                 prefix_cache: bool = False):
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -830,9 +925,22 @@ class ContinuousBatcher:
             self._table = self._table_np.copy()
             self._table_dirty = False
             self._lens = jnp.zeros((n_slots,), jnp.int32)
-            self._slot_pages: Dict[int, list] = {}   # slot -> page ids
+            self._slot_pages: Dict[int, list] = {}   # slot -> OWNED page ids
+            self._slot_shared: Dict[int, list] = {}  # slot -> shared (hit)
+            self._slot_prompt: Dict[int, list] = {}  # slot -> prompt tokens
             self._last_denied: Optional[int] = None  # req id, dedupes metric
+            # Radix prefix cache (models/prefix_cache.py): reaped prompts
+            # donate their full-page KV into a token-chunk tree; admission
+            # mounts the longest cached page-aligned prefix read-only and
+            # prefills only the novel tail.
+            self._prefix = (PrefixCache(self._alloc, page_size)
+                            if prefix_cache else None)
+            self._skipped_tokens = 0                 # prefill rows reused
         else:
+            if prefix_cache:
+                raise ValueError(
+                    "prefix_cache=True requires kv_layout='paged' (the "
+                    "contiguous cursor cache has no shareable pages)")
             if kv_dtype == "int8":
                 shape = (cfg.n_layers, n_slots, self.S, cfg.n_kv_heads,
                          cfg.head_dim)
@@ -883,10 +991,10 @@ class ContinuousBatcher:
                 donate_argnums=(1, 2, 3, 4, 5),
             )
             self._prefill = jax.jit(
-                lambda p, k, v, ks, vs, lens, last, slots, pids, tokens,
-                real_lens, seed: _prefill_multi_paged_fn(
-                    p, cfg, ps, k, v, lens, last, slots, pids, tokens,
-                    real_lens, seed, temp, tk, k_s=ks, v_s=vs),
+                lambda p, k, v, ks, vs, lens, last, slots, pids, ptbl,
+                hlens, tokens, tlens, seed: _prefill_multi_paged_fn(
+                    p, cfg, ps, k, v, lens, last, slots, pids, ptbl,
+                    hlens, tokens, tlens, seed, temp, tk, k_s=ks, v_s=vs),
                 donate_argnums=(1, 2, 3, 4),
             )
         else:
@@ -1117,8 +1225,39 @@ class ContinuousBatcher:
         at finish."""
         return -(-(prompt_len + self._rows_needed(budget)) // self.page_size)
 
+    def _hb_bucket(self, n_hit_pages: int) -> int:
+        """Prefix-table width bucket for a hit of ``n_hit_pages`` pages:
+        0 stays 0 (the plain prefill program), else the next power of two
+        clamped to the table width — one compiled tail-prefill program
+        per (tb, hb) rung actually used, the ladder idea again."""
+        if n_hit_pages == 0:
+            return 0
+        hb = 1
+        while hb < n_hit_pages:
+            hb *= 2
+        return min(hb, self.n_blocks)
+
+    def _retire_pages(self, own: list, shared: list,
+                      prompt: Optional[list]) -> None:
+        """A request is done with its pages: donate the full-prompt-chunk
+        pages into the prefix tree where the path is new (the slot's
+        reference transfers — models/prefix_cache.py insert), and drop
+        one reference on everything else — the shared hit pages it
+        mounted (tree/other slots keep theirs) and its own partial/decode
+        pages (refcount 0 → back to the free list)."""
+        adopted: set = set()
+        if self._prefix is not None and prompt is not None:
+            n_full = len(prompt) // self.page_size
+            adopted = set(self._prefix.insert(
+                prompt, (shared + own)[:n_full]))
+        release = [p for p in shared + own if p not in adopted]
+        if release:
+            self._alloc.free(release)
+
     def _free_slot_pages(self, slot: int) -> None:
-        self._alloc.free(self._slot_pages.pop(slot))
+        self._retire_pages(self._slot_pages.pop(slot),
+                           self._slot_shared.pop(slot, []),
+                           self._slot_prompt.pop(slot, None))
         self._table_np[slot] = NULL_PAGE
         self._table_dirty = True
 
@@ -1131,14 +1270,31 @@ class ContinuousBatcher:
         design pays every ~S decode steps simply does not exist."""
         finished: list = []
         free = [s for s in range(self.n_slots) if s not in self._slot_req]
-        adm: list = []                 # (req id, slot, pages, prompt, bucket)
-        free_after: list = []          # max_new==1 pages: freed post-dispatch
+        adm: list = []           # (req id, slot, pages, prompt, bucket, hits)
+        free_after: list = []    # max_new==1 pages: retired post-dispatch
         while free and self._queue and len(adm) < self.n_slots:
             req_id, prompt = self._queue[0]
             P = len(prompt)
+            hits: list = []
+            if self._prefix is not None:
+                # Longest cached page-aligned prefix (always leaves >= 1
+                # token to prefill — the admission needs last-position
+                # logits). Retain BEFORE any eviction below: the slot's
+                # reference pins the hit path at refcount >= 2, so the
+                # LRU sweep can never reclaim pages we are mounting.
+                # Retries of a page-blocked head re-match every step but
+                # count once, like the allocator's denial metric.
+                hits = self._prefix.match(
+                    prompt, count=req_id != self._last_denied)
+                if hits:
+                    self._alloc.retain(hits)
+            need = self._pages_needed(P, self._budget[req_id]) - len(hits)
+            if self._prefix is not None and need > self._alloc.free_count:
+                # Tree-only pages are reclaimable capacity, not occupancy:
+                # evict the coldest unshared leaves to make room.
+                self._prefix.evict(need - self._alloc.free_count)
             pages = self._alloc.alloc(
-                self._pages_needed(P, self._budget[req_id]),
-                count_denied=req_id != self._last_denied)
+                need, count_denied=req_id != self._last_denied)
             if pages is None:
                 # No pages for the head — STOP admitting (strict FCFS, the
                 # same starvation argument as the contiguous path: letting
@@ -1146,6 +1302,8 @@ class ContinuousBatcher:
                 # pool drained and starve it indefinitely). Occupied slots
                 # finish, free their pages, and the head admits. The
                 # denial counts ONCE per request, not once per retry step.
+                if hits:
+                    self._alloc.free(hits)           # unwind the match pin
                 self._last_denied = req_id
                 break
             if req_id == self._last_denied:
@@ -1154,55 +1312,76 @@ class ContinuousBatcher:
             slot = free.pop()
             row = self._table_np[slot]
             row[:] = NULL_PAGE
-            row[:len(pages)] = pages
+            row[:len(hits)] = hits                   # shared, read-only
+            row[len(hits):len(hits) + len(pages)] = pages
             self._table_dirty = True
-            # Bucket rounded up to page granularity: the prefill scatter
-            # writes whole page blocks, so tb must be a page multiple
-            # (ladder rungs below page_size round up to one page).
-            tb = -(-self._ladder(P) // self.page_size) * self.page_size
-            adm.append((req_id, slot, pages, prompt, tb))
+            hit_tok = len(hits) * self.page_size
+            self._skipped_tokens += hit_tok
+            # Bucket the UNCACHED TAIL, rounded up to page granularity:
+            # the prefill scatter writes whole page blocks, so tb must be
+            # a page multiple (ladder rungs below page_size round up to
+            # one page) — with a hit, prefill cost scales with the novel
+            # suffix, which is the whole point of the cache.
+            tb = -(-self._ladder(P - hit_tok) // self.page_size) \
+                * self.page_size
+            adm.append((req_id, slot, pages, prompt,
+                        (tb, self._hb_bucket(len(hits))), hits))
             self._budget[req_id] -= 1                # first token = prefill
             if self._budget[req_id] <= 0:            # max_new == 1
                 finished.append(req_id)
                 del self._budget[req_id]
                 free.append(slot)                    # slot never occupied
                 # The prefill dispatch below still writes these pages;
-                # they are recycled only after it is enqueued.
-                free_after.append(pages)
+                # they are retired (donated + released) only after it is
+                # enqueued.
+                free_after.append((pages, hits, prompt))
             else:
                 self._slot_req[slot] = req_id
                 self._slot_pages[slot] = pages
+                self._slot_shared[slot] = hits
+                self._slot_prompt[slot] = prompt
 
         # Same one-padded-dispatch-per-rung grouping as the contiguous
         # path (_group_admissions: slot-repeat contiguity split, pad with
         # the LAST entry — duplicate page ids then carry identical
         # values, keeping the scatter idempotent).
         for run in self._group_admissions(adm):
-            tb = run[0][4]
+            tb, hb = run[0][4]
             npg = -(-tb // self.page_size)
             rows = run + [run[-1]] * (self.n_slots - len(run))
+            # Tail tokens only: the cached prefix (hit pages) is already
+            # resident; its length per entry rides as hlens.
+            tails = [p[len(h) * self.page_size:]
+                     for _, _, _, p, _, h in rows]
             tokens = np.asarray(
-                [p + [0] * (tb - len(p)) for _, _, _, p, _ in rows],
-                np.int32)
-            # Page-id matrix for the prefill scatter: the entry's reserved
-            # pages in logical order; the beyond-need tail of an
-            # overshooting bucket targets the null page.
+                [t + [0] * (tb - len(t)) for t in tails], np.int32)
+            # Page-id matrix for the prefill scatter: the entry's OWN
+            # reserved pages in logical order; the beyond-need tail of an
+            # overshooting bucket targets the null page. Shared hit pages
+            # are deliberately absent — the scatter must never touch them.
             pids = np.asarray(
                 [[pg[j] if j < len(pg) else NULL_PAGE for j in range(npg)]
-                 for _, _, pg, _, _ in rows], np.int32)
+                 for _, _, pg, _, _, _ in rows], np.int32)
+            ptbl = np.asarray(
+                [[h[j] if j < len(h) else NULL_PAGE for j in range(hb)]
+                 for _, _, _, _, _, h in rows], np.int32).reshape(
+                self.n_slots, hb)                    # keep [M, 0] 2-D
+            hlens = np.asarray(
+                [len(h) * self.page_size for _, _, _, _, _, h in rows],
+                np.int32)
             self._dispatch_no += 1
             (self._k, self._v, self._ks, self._vs, self._lens, self._last,
              firsts_arr) = self._prefill(
                 self.params, self._k, self._v, self._ks, self._vs,
                 self._lens, self._last,
-                np.asarray([s for _, s, _, _, _ in rows], np.int32),
-                pids, tokens,
-                np.asarray([len(p) for _, _, _, p, _ in rows], np.int32),
+                np.asarray([s for _, s, *_ in rows], np.int32),
+                pids, ptbl, hlens, tokens,
+                np.asarray([len(t) for t in tails], np.int32),
                 np.int32(self._dispatch_no))
             self._reads.append(
-                ("firsts", firsts_arr, [rid for rid, _, _, _, _ in run]))
-        for pages in free_after:
-            self._alloc.free(pages)
+                ("firsts", firsts_arr, [rid for rid, *_ in run]))
+        for pages, hits, prompt in free_after:
+            self._retire_pages(pages, hits, prompt)
 
         if not self._slot_req:
             return finished
@@ -1236,12 +1415,20 @@ class ContinuousBatcher:
 
     def pool_metrics(self) -> Dict[str, float]:
         """Page-pool health (paged layout only; {} otherwise): total/free/
-        in-use/watermark page counts, alloc/free/denied churn, and the
-        instantaneous utilization — the fragmentation-side observability
-        the serving entrypoint publishes next to the latency records."""
+        in-use/cached/watermark page counts, alloc/free/denied churn, the
+        instantaneous utilization, and — with the prefix cache on — the
+        reuse counters (hit rates, cached pages, evictions, prefill
+        tokens skipped). The fragmentation-and-reuse observability the
+        serving entrypoint publishes next to the latency records
+        (metrics.exporter.export_serving_pool maps it onto Prometheus
+        gauges)."""
         if self.layout != "paged":
             return {}
-        return self._alloc.metrics()
+        out = self._alloc.metrics()
+        if self._prefix is not None:
+            out.update(self._prefix.metrics())
+            out["prefill_tokens_skipped"] = float(self._skipped_tokens)
+        return out
 
     def _flush(self) -> None:
         """Materialize every outstanding result array in ONE batched

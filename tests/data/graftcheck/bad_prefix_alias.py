@@ -1,0 +1,34 @@
+"""Seeded-bad fixture: a prefill that WRITES a shared prefix page.
+
+Same ``GRAFTCHECK_ALIAS_AUDIT`` hook protocol as the repo's own alias
+scenarios (analysis/alias.py): ``build()`` returns
+``(fn, args, pool_argnums, pool_outnums, shared_pages)``. The jitted
+"prefill" here scatters its page blocks at ids [1, 2] while page 1 is
+declared shared — the exact off-by-one a refactor of the admission
+bookkeeping could introduce (mounting the hit pages but handing the
+scatter the WHOLE block-table row instead of only the owned tail). Every
+slot sharing page 1 would silently read this request's KV as its system
+prompt — no crash, just corrupted streams — which is why the audit
+byte-compares the declared pages instead of trusting the bookkeeping.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _build():
+    # [L, n_pages, page_size, Hkv, hd] — the serving pool layout.
+    pool = jnp.zeros((2, 4, 8, 2, 4), jnp.float32)
+    new = jnp.ones((2, 2, 8, 2, 4), jnp.float32)
+
+    @jax.jit
+    def prefill(pool, new):
+        # BUG: page 1 is a mounted prefix page; only page 2 (and beyond)
+        # is this request's own.
+        return (pool.at[:, jnp.asarray([1, 2])].set(new),)
+
+    return prefill, (pool, new), (0,), (0,), [1]
+
+
+GRAFTCHECK_ALIAS_AUDIT = [
+    ("prefill_writes_shared_page", _build),
+]

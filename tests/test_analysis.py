@@ -428,6 +428,100 @@ class TestBatcherSteadyState:
         eng.run()                                  # drain the long request
 
 
+# -- shared-page (alias) audit ------------------------------------------------
+
+class TestAliasAudit:
+    def test_bad_fixture_caught(self):
+        from k8s_gpu_scheduler_tpu.analysis.alias import audit_shared_pages
+
+        sys.path.insert(0, FIXTURES)
+        try:
+            import bad_prefix_alias
+        finally:
+            sys.path.pop(0)
+        (name, build), = bad_prefix_alias.GRAFTCHECK_ALIAS_AUDIT
+        findings = audit_shared_pages(build, name)
+        assert rules_of(findings) == {"shared-page-write"}
+        assert "page(s) [1]" in findings[0].message
+
+    def test_clean_writer_passes_and_vacuous_audit_does_not(self):
+        import jax
+        import jax.numpy as jnp
+
+        from k8s_gpu_scheduler_tpu.analysis.alias import check_shared_pages
+
+        pool = jnp.zeros((2, 4, 8), jnp.float32)
+        new = jnp.ones((2, 1, 8), jnp.float32)
+        good = jax.jit(
+            lambda p, n: (p.at[:, jnp.asarray([2])].set(n),))
+        assert check_shared_pages(good, (pool, new), (0,), (0,),
+                                  [1], name="good") == []
+        # No shared pages declared -> the audit verified nothing, which
+        # must surface as a finding rather than read as a clean pass.
+        vac = check_shared_pages(good, (pool, new), (0,), (0,), [],
+                                 name="vacuous")
+        assert rules_of(vac) == {"alias-guard"}
+
+    def test_engine_scenarios_are_clean(self):
+        """The repo's own prefill-with-hit and decode-over-shared-rows
+        dispatches uphold the copy-on-write contract."""
+        from k8s_gpu_scheduler_tpu.analysis import entrypoints as eps
+        from k8s_gpu_scheduler_tpu.analysis.alias import audit_shared_pages
+
+        for name, build in eps.alias_scenarios():
+            findings = audit_shared_pages(build, name)
+            assert findings == [], "\n".join(f.render() for f in findings)
+
+
+class TestPrefixBatcherSteadyState:
+    def test_prefix_hits_three_chunks_zero_retrace(self, recompile_guard):
+        """Steady-state decode with PREFIX-CACHE HITS: after warmup has
+        compiled the miss and hit prefill rungs, waves of shared-prefix
+        admissions (varying suffixes, varying tables, shared pages
+        mounted read-only) must be zero-retrace with the pool still
+        riding the donation chain."""
+        import jax
+
+        from k8s_gpu_scheduler_tpu.models.llama import (
+            LlamaConfig, init_params,
+        )
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = LlamaConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=48,
+                                chunk=2, prefill_bucket=8, kv_dtype="int8",
+                                kv_layout="paged", page_size=8,
+                                prefix_cache=True)
+        rng = np.random.default_rng(0)
+        sysp = list(rng.integers(0, cfg.vocab, 8))
+        # Warmup: the miss rung, then (reap donated) the hit rung.
+        eng.submit(sysp + list(rng.integers(0, cfg.vocab, 5)), max_new=3)
+        eng.run()
+        eng.submit(sysp + list(rng.integers(0, cfg.vocab, 5)), max_new=3)
+        eng.run()
+        # Pin a slot + warm both block-table jit keys (committed/numpy).
+        eng.submit(sysp + list(rng.integers(0, cfg.vocab, 5)), max_new=15)
+        eng.step()
+        eng.step()
+
+        recompile_guard.track("decode", eng._decode)
+        recompile_guard.track("prefill", eng._prefill)
+        recompile_guard.snapshot()
+        for suffix in (3, 4, 5):
+            eng.submit(sysp + list(rng.integers(0, cfg.vocab, suffix)),
+                       max_new=2)
+            k_before = eng._k
+            eng.step()
+            assert k_before.is_deleted(), "kv page pool was not donated"
+        assert recompile_guard.misses_since() == {"decode": 0,
+                                                  "prefill": 0}
+        m = eng.pool_metrics()
+        assert m["prefix_hit_tokens"] > 0, "waves must actually hit"
+        eng.run()
+        eng._alloc.assert_consistent()
+
+
 # -- CLI contract -------------------------------------------------------------
 
 def run_cli(*extra, fast=True):
@@ -451,15 +545,16 @@ class TestCli:
             assert proc.returncode == 1, (fixture, proc.stderr)
             assert ": [" in proc.stderr       # file:line: [rule] rendering
 
-    def test_full_cli_catches_all_four_fixture_families(self):
-        """The acceptance criterion end-to-end: the DEFAULT four-pass CLI
+    def test_full_cli_catches_all_five_fixture_families(self):
+        """The acceptance criterion end-to-end: the DEFAULT five-pass CLI
         exits non-zero with file:line findings when the seeded bad
         fixtures are in the scanned paths (one subprocess run for all
-        four — the traced passes dominate its ~15 s)."""
+        five — the traced passes dominate its ~15 s)."""
         proc = run_cli(FIXTURES, "--json", fast=False)
         assert proc.returncode == 1, proc.stderr
         import json as _json
 
         summary = _json.loads(proc.stdout.strip().splitlines()[-1])
         assert {"lock-guard", "vmem-budget", "captured-const",
-                "steady-state-retrace"} <= set(summary["rules"])
+                "steady-state-retrace", "shared-page-write"} \
+            <= set(summary["rules"])

@@ -213,6 +213,79 @@ class TestExporter:
             reg.gauge("m", "x")
 
 
+class TestServingPoolExport:
+    def test_pool_metrics_become_prometheus_gauges(self):
+        """The serving pool/prefix-cache numbers that previously lived
+        only in pool_metrics()/bench ride the standard /metrics
+        exposition: every published key gets a tpu_serve_* gauge with
+        help text, and scraping round-trips the values."""
+        from k8s_gpu_scheduler_tpu.metrics import (
+            SERVING_POOL_GAUGES, export_serving_pool,
+        )
+
+        reg = Registry()
+        snapshot = {
+            "pages_total": 32.0, "pages_free": 20.0, "pages_in_use": 12.0,
+            "pages_cached": 5.0, "pages_watermark": 14.0,
+            "page_utilization": 0.375, "prefix_hit_rate": 0.8,
+            "prefix_request_hit_rate": 1.0, "prefix_cached_pages": 5.0,
+            "prefix_evictions": 2.0, "prefill_tokens_skipped": 576.0,
+        }
+        export_serving_pool(reg, snapshot)
+        text = reg.expose()
+        assert "tpu_serve_page_utilization 0.375" in text
+        assert "tpu_serve_pages_watermark 14.0" in text
+        assert "tpu_serve_prefix_hit_rate 0.8" in text
+        assert "tpu_serve_prefix_cached_pages 5.0" in text
+        assert "tpu_serve_prefix_evictions 2.0" in text
+        assert "tpu_serve_prefill_tokens_skipped 576.0" in text
+        assert "# HELP tpu_serve_pages_cached" in text
+        # Every exported key is documented in the gauge map.
+        assert set(snapshot) <= set(SERVING_POOL_GAUGES)
+
+    def test_absent_keys_are_skipped(self):
+        """Contiguous layout ({}) and prefix-cache-off snapshots publish
+        what they have — unconditional per-step publishing is safe."""
+        from k8s_gpu_scheduler_tpu.metrics import export_serving_pool
+
+        reg = Registry()
+        export_serving_pool(reg, {})
+        assert "tpu_serve" not in reg.expose()
+        export_serving_pool(reg, {"pages_free": 3.0})
+        assert "tpu_serve_pages_free 3.0" in reg.expose()
+
+    def test_live_engine_snapshot_exports(self):
+        """End to end against a real paged engine with the prefix cache:
+        pool_metrics() -> gauges, including the reuse counters."""
+        import dataclasses
+
+        import jax
+        import numpy as np
+
+        from k8s_gpu_scheduler_tpu.metrics import export_serving_pool
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = dataclasses.replace(LlamaConfig.tiny())
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=32,
+                                chunk=2, prefill_bucket=8,
+                                kv_layout="paged", page_size=8,
+                                prefix_cache=True)
+        sysp = list(rng.integers(0, cfg.vocab, 8))
+        for _ in range(2):
+            eng.submit(sysp + list(rng.integers(0, cfg.vocab, 3)),
+                       max_new=2)
+            eng.run()
+        reg = Registry()
+        export_serving_pool(reg, eng.pool_metrics())
+        text = reg.expose()
+        assert "tpu_serve_prefill_tokens_skipped 8.0" in text
+        assert "tpu_serve_prefix_cached_pages 1.0" in text
+        assert "tpu_serve_pages_total 8.0" in text
+
+
 class TestSchedulerMetrics:
     def test_scheduler_records_latency_and_attempts(self):
         from k8s_gpu_scheduler_tpu.cluster import APIServer, Descriptor

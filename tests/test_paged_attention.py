@@ -345,6 +345,51 @@ class TestPagedEngine:
         assert out[-1] == eos and len(out) < 20
         assert eng.pool_metrics()["pages_in_use"] == 0
 
+    def test_reaped_shared_pages_stay_out_of_the_free_list(self):
+        """EOS/reap × prefix sharing: when a reaped request's prefix
+        pages are still referenced by a live slot (and the tree), they
+        must NOT return to the free list until the last reference drops —
+        a premature free would hand a live slot's system prompt to the
+        next admission as scratch."""
+        from k8s_gpu_scheduler_tpu.models import init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = self._cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(5)
+        sysp = list(rng.integers(0, cfg.vocab, 16))  # 2 shareable pages
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                                chunk=4, prefill_bucket=8,
+                                kv_layout="paged", page_size=8,
+                                prefix_cache=True)
+        # Donor: populates the tree at reap.
+        eng.submit(sysp + list(rng.integers(0, cfg.vocab, 3)), max_new=2)
+        eng.run()
+        shared = eng._prefix.match(sysp + [1])
+        assert len(shared) == 2
+        # Two sharers: A reaps early, B keeps decoding on the same pages.
+        a = eng.submit(sysp + list(rng.integers(0, cfg.vocab, 3)),
+                       max_new=2)
+        b = eng.submit(sysp + list(rng.integers(0, cfg.vocab, 5)),
+                       max_new=17)
+        done = {}
+        while a not in done:
+            done.update(eng.step())
+        assert eng.pending                           # B still live
+        for p in shared:
+            # tree + B: two references, and nowhere near the free list.
+            assert eng._alloc.ref(p) == 2
+            assert p not in eng._alloc._free
+        eng._alloc.assert_consistent()
+        done.update(eng.run())                       # B drains, releases
+        for p in shared:
+            assert eng._alloc.ref(p) == 1            # tree's reference only
+        eng._prefix.evict(10)                        # last reference drops
+        for p in shared:
+            assert eng._alloc.ref(p) == 0
+            assert p in eng._alloc._free
+        eng._alloc.assert_consistent()
+
     def test_paged_rejects_mesh_and_bad_page_size(self):
         from k8s_gpu_scheduler_tpu.models import init_params
         from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher

@@ -1,0 +1,347 @@
+"""Shared-prefix KV reuse (models/prefix_cache.py + the ref-counted
+PageAllocator + the prefix-aware paged ContinuousBatcher).
+
+The correctness story has two legs the suite pins separately:
+
+1. **Token identity** — with ``prefix_cache=True`` a batch of
+   shared-prefix requests must produce byte-identical token streams to
+   the cache-off paged path (itself pinned against the contiguous
+   engine by tests/test_paged_attention.py), across dense/fused × cache
+   dtypes, THROUGH evictions, and after a reaped request's donated pages
+   are re-shared. The cached pages hold exactly the bytes the cache-off
+   prefill would have written (prefill KV of a prefix is a deterministic
+   function of the prefix), so reuse must be output-invisible. For the
+   int8-KV cases the guarantee is quantization-noise-bounded rather
+   than structural — the tail prefill attends the dequantized prefix
+   (the values decode also attends) where cache-off attends its bf16
+   mini cache, so a near-exact first-token logit tie could flip; these
+   tests pin fixed seeds/configs where it must not (see the parity note
+   on serving._prefill_multi_paged_fn).
+2. **Reference discipline** — a shared page never returns to the free
+   list while any slot or the tree holds it, double frees raise before
+   mutating, and free ∪ held ∪ cached always partitions the pool
+   (``assert_consistent``). The write-side of the contract (shared pages
+   are read-only) is enforced by the graftcheck alias audit
+   (tests/test_analysis.py::TestAliasAudit).
+"""
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_scheduler_tpu.models.paging import NULL_PAGE, PageAllocator
+from k8s_gpu_scheduler_tpu.models.prefix_cache import PrefixCache
+
+
+# -- radix tree ---------------------------------------------------------------
+
+class TestPrefixTree:
+    def _cache(self, n_pages=17, ps=4):
+        alloc = PageAllocator(n_pages)
+        return PrefixCache(alloc, ps), alloc
+
+    def test_match_is_page_aligned_longest_prefix(self):
+        cache, alloc = self._cache()
+        pages = alloc.alloc(3)
+        toks = list(range(12))
+        assert cache.insert(toks, pages) == pages    # all three adopted
+        assert cache.match(toks + [99]) == pages     # full 12-token hit
+        assert cache.match(toks[:11]) == pages[:2]   # partial page -> 2
+        assert cache.match(toks[:8] + [7, 7, 7, 7]) == pages[:2]
+        assert cache.match([5] + toks) == []         # shifted: no hit
+        alloc.assert_consistent()
+
+    def test_match_always_leaves_a_token_to_prefill(self):
+        """A FULLY cached page-aligned prompt matches one page short —
+        admission needs the last-position logits for its first token."""
+        cache, alloc = self._cache()
+        pages = alloc.alloc(3)
+        toks = list(range(12))
+        cache.insert(toks, pages)
+        assert cache.match(toks) == pages[:2]        # not all 3
+
+    def test_insert_adopts_only_novel_chunks(self):
+        cache, alloc = self._cache()
+        a = alloc.alloc(2)
+        cache.insert(list(range(8)), a)
+        b = alloc.alloc(2)
+        # Same first chunk, new second chunk: only b[1] adopted; b[0] is
+        # the caller's duplicate to release.
+        adopted = cache.insert(list(range(4)) + [9, 9, 9, 9], b)
+        assert adopted == [b[1]]
+        assert cache.match(list(range(4)) + [9, 9, 9, 9, 1]) == [a[0], b[1]]
+        alloc.free([b[0]])
+        alloc.assert_consistent()
+
+    def test_eviction_is_lru_and_leaf_only(self):
+        cache, alloc = self._cache()
+        a = alloc.alloc(2)                           # path of depth 2
+        cache.insert(list(range(8)), a)
+        b = alloc.alloc(1)                           # sibling branch
+        cache.insert(list(range(4)) + [7, 7, 7, 7], [a[0]] + b)
+        cache.match(list(range(8)) + [0])            # path a is now newest
+        # One eviction: the LRU *leaf* is b's node — NOT a[0], which is
+        # an interior node (evicting it would strand a[1]'s context).
+        assert cache.evict(1) == 1
+        assert cache.match(list(range(4)) + [7, 7, 7, 7, 1]) == [a[0]]
+        assert cache.match(list(range(8)) + [0]) == a
+        # Draining the rest peels leaves upward.
+        assert cache.evict(10) == 2
+        assert len(cache) == 0
+        assert alloc.free_count == alloc.n_pages - 1
+        alloc.assert_consistent()
+
+    def test_eviction_skips_pages_slots_still_share(self):
+        cache, alloc = self._cache()
+        a = alloc.alloc(2)
+        cache.insert(list(range(8)), a)
+        alloc.retain([a[1]])                         # a slot mounts the leaf
+        assert cache.evict(5) == 0                   # leaf pinned, parent interior
+        alloc.free([a[1]])                           # slot reaps
+        assert cache.evict(5) == 2
+        alloc.assert_consistent()
+
+    def test_insert_shorter_than_chunks_raises(self):
+        cache, alloc = self._cache()
+        with pytest.raises(ValueError, match="chunks"):
+            cache.insert(list(range(8)), alloc.alloc(1))
+
+
+# -- ref-counted allocator ----------------------------------------------------
+
+class TestRefCounting:
+    def test_shared_page_outlives_individual_frees(self):
+        a = PageAllocator(5)
+        pages = a.alloc(2)
+        a.retain([pages[0]])                         # second holder
+        a.free(pages)                                # first holder drops both
+        assert a.ref(pages[0]) == 1 and a.ref(pages[1]) == 0
+        assert pages[1] in a._free and pages[0] not in a._free
+        a.free([pages[0]])                           # last reference
+        assert a.free_count == 4
+        a.assert_consistent()
+
+    def test_retain_free_foreign_pages_raise(self):
+        a = PageAllocator(5)
+        with pytest.raises(RuntimeError, match="retain"):
+            a.retain([3])
+        with pytest.raises(ValueError, match="null page"):
+            a.retain([NULL_PAGE])
+        held = a.alloc(1)
+        with pytest.raises(RuntimeError, match="double free"):
+            a.free(held + held)                      # 2 drops, 1 reference
+
+    def test_cached_page_cannot_leave_via_free(self):
+        """The tree's reference drops via drop_cached (eviction) only —
+        free() reaching it means slot bookkeeping leaked."""
+        a = PageAllocator(5)
+        p = a.alloc(1)
+        a.adopt(p)
+        with pytest.raises(RuntimeError, match="cached"):
+            a.free(p)
+        a.retain(p)                                  # slot share: free ok
+        a.free(p)
+        a.drop_cached(p[0])
+        assert a.free_count == 4
+        with pytest.raises(RuntimeError, match="not cached"):
+            a.drop_cached(p[0])
+        a.assert_consistent()
+
+    def test_assert_consistent_catches_corruption(self):
+        a = PageAllocator(5)
+        held = a.alloc(2)
+        a.assert_consistent()
+        a._free.append(held[0])                      # free AND allocated
+        with pytest.raises(RuntimeError, match="both free and allocated"):
+            a.assert_consistent()
+        a._free.pop()
+        del a._ref[held[0]]                          # vanished page
+        with pytest.raises(RuntimeError, match="not covered"):
+            a.assert_consistent()
+
+
+# -- engine parity ------------------------------------------------------------
+
+def _engine(params, cfg, **kw):
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+    base = dict(n_slots=2, max_len=64, chunk=4, prefill_bucket=8,
+                kv_layout="paged", page_size=8)
+    base.update(kw)
+    return ContinuousBatcher(params, cfg, **base)
+
+
+class TestPrefixEngineParity:
+    def _setup(self, dtype=jnp.float32, **cfg_kw):
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=dtype, **cfg_kw)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        sysA = list(rng.integers(0, cfg.vocab, 16))  # 2 pages
+        sysB = list(rng.integers(0, cfg.vocab, 16))
+        prompts = [sysA + list(rng.integers(0, cfg.vocab, 5))
+                   for _ in range(3)]
+        prompts += [sysB + list(rng.integers(0, cfg.vocab, 3))
+                    for _ in range(3)]
+        return cfg, params, prompts
+
+    def _drive(self, params, cfg, prompts, prefix_cache, **kw):
+        eng = _engine(params, cfg, prefix_cache=prefix_cache, **kw)
+        ids = [eng.submit(p, max_new=5) for p in prompts]
+        done = eng.run()
+        return [done[i] for i in ids], eng
+
+    @pytest.mark.parametrize("kvd", [None, "int8"])
+    @pytest.mark.parametrize("impl", ["dense", "fused"])
+    def test_cache_on_matches_cache_off(self, impl, kvd):
+        """The acceptance grid: shared-prefix batches are token-identical
+        with the cache on and off, dense and fused, both cache dtypes —
+        and the reuse actually happened (tokens skipped, pages shared)."""
+        cfg, params, prompts = self._setup(decode_attn=impl)
+        on, eng = self._drive(params, cfg, prompts, True, kv_dtype=kvd)
+        off, _ = self._drive(params, cfg, prompts, False, kv_dtype=kvd)
+        assert on == off
+        m = eng.pool_metrics()
+        assert m["prefill_tokens_skipped"] > 0
+        assert m["prefix_request_hit_rate"] > 0
+        # At drain only the tree holds pages: in_use == cached, and the
+        # pool still partitions cleanly.
+        assert m["pages_in_use"] == m["pages_cached"] > 0
+        eng._alloc.assert_consistent()
+
+    def test_bf16_cache_on_matches_cache_off(self):
+        cfg, params, prompts = self._setup(dtype=jnp.bfloat16,
+                                           decode_attn="fused")
+        on, _ = self._drive(params, cfg, prompts, True, kv_dtype="int8")
+        off, _ = self._drive(params, cfg, prompts, False, kv_dtype="int8")
+        assert on == off
+
+    def test_parity_through_evictions_and_resharing(self):
+        """A pool too small to cache everything: admissions force LRU
+        evictions, reaped requests re-donate, later requests re-share the
+        re-donated pages — and the streams still match cache-off exactly."""
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(1)
+        sys_prompts = [list(rng.integers(0, cfg.vocab, 16))
+                       for _ in range(4)]
+        prompts = [sys_prompts[i % 4]
+                   + list(rng.integers(0, cfg.vocab, 5))
+                   for i in range(12)]
+        # 9 usable pages, 4 per admission: constant eviction pressure.
+        on, eng = self._drive(params, cfg, prompts, True, n_pages=10)
+        off, _ = self._drive(params, cfg, prompts, False, n_pages=10)
+        assert on == off
+        m = eng.pool_metrics()
+        assert m["prefix_evictions"] > 0, "scenario must actually evict"
+        assert m["prefix_request_hit_rate"] > 0, "and still hit"
+        eng._alloc.assert_consistent()
+
+    def test_reshared_after_reap_matches(self):
+        """Sequential waves: wave 1 populates the tree (donation at
+        reap), wave 2 re-shares the SAME donated pages — token identity
+        must survive the page handoff."""
+        cfg, params, prompts = self._setup()
+        eng = _engine(params, cfg, prefix_cache=True)
+        out_on = {}
+        for p in prompts:                            # one at a time: every
+            rid = eng.submit(p, max_new=5)           # later wave re-shares
+            out_on[rid] = eng.run()[rid]
+        off, _ = self._drive(params, cfg, prompts, False)
+        assert list(out_on.values()) == off
+        assert eng.pool_metrics()["prefix_request_hit_rate"] \
+            == pytest.approx(4 / 6)                  # all but the 2 firsts
+
+
+class TestPrefixEngineBehavior:
+    def _tiny(self):
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+        return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+    def test_prefix_cache_requires_paged_layout(self):
+        cfg, params = self._tiny()
+        with pytest.raises(ValueError, match="paged"):
+            _engine(params, cfg, kv_layout="contiguous", prefix_cache=True)
+
+    def test_fully_cached_prompt_still_prefills_its_last_page(self):
+        """A page-aligned prompt that is entirely cached must still admit
+        and produce correct output (the match cap leaves the final page
+        to prefill for the first-token logits)."""
+        cfg, params = self._tiny()
+        rng = np.random.default_rng(2)
+        prompt = list(rng.integers(0, cfg.vocab, 16))  # exactly 2 pages
+        eng = _engine(params, cfg, prefix_cache=True)
+        a = eng.submit(prompt, max_new=4)
+        first = eng.run()[a]
+        b = eng.submit(prompt, max_new=4)              # full-prompt hit
+        second = eng.run()[b]
+        assert first == second
+        # Only ONE page was reusable (cap), and it was reused.
+        assert eng.pool_metrics()["prefill_tokens_skipped"] == 8
+
+    def test_pool_never_leaks_across_a_burst(self):
+        cfg, params = self._tiny()
+        rng = np.random.default_rng(3)
+        eng = _engine(params, cfg, prefix_cache=True, n_slots=2)
+        sysp = list(rng.integers(0, cfg.vocab, 8))
+        for wave in range(3):
+            for _ in range(3):
+                eng.submit(sysp + list(rng.integers(0, cfg.vocab, 4)),
+                           max_new=3)
+            eng.run()
+            eng._alloc.assert_consistent()
+        m = eng.pool_metrics()
+        assert m["pages_in_use"] == m["pages_cached"]
+        # Evict everything: the pool drains back to pristine.
+        eng._prefix.evict(int(m["pages_cached"]))
+        assert eng.pool_metrics()["pages_in_use"] == 0
+        eng._alloc.assert_consistent()
+
+    def test_max_new_one_request_still_donates(self):
+        """The prefill-only (max_new==1) path retires through the same
+        donation bookkeeping: its prompt becomes reusable."""
+        cfg, params = self._tiny()
+        rng = np.random.default_rng(4)
+        prompt = list(rng.integers(0, cfg.vocab, 11))
+        eng = _engine(params, cfg, prefix_cache=True)
+        eng.submit(prompt, max_new=1)
+        eng.run()
+        assert eng.pool_metrics()["prefix_cached_pages"] == 1
+        assert eng._prefix.match(prompt) != []
+
+
+class TestBenchLeg:
+    def test_prefix_cache_bench_smoke(self):
+        """`bench.py --leg prefix_cache --smoke` must emit ONE JSON line
+        whose reuse contract holds: prefill tokens skipped > 0 and a
+        steady-state request hit rate >= 0.9 on the K-shared-prompts
+        workload — the acceptance numbers the CI bench step gates on."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "bench.py", "--leg", "prefix_cache",
+             "--smoke"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            capture_output=True, text=True, timeout=600, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+        assert len(lines) == 1, out.stdout
+        rec = json.loads(lines[0])
+        assert rec["metric"] == "prefix_cache_bench"
+        extra = rec["extra"]
+        assert extra["prefix_cache_tokens_skipped"] > 0
+        assert extra["prefix_cache_request_hit_rate"] >= 0.9
+        assert 0 < extra["prefix_cache_hit_rate"] <= 1.0
+        for key in ("prefix_cache_ttft_p50_ms", "prefix_cache_off_ttft_p50_ms",
+                    "prefix_cache_page_utilization"):
+            assert extra.get(key, 0) > 0, (key, extra)
