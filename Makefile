@@ -37,6 +37,7 @@ bench:
 bench-smoke:
 	$(PY) bench.py --leg paged_attention --smoke
 	$(PY) bench.py --leg prefix_cache --smoke
+	$(PY) bench.py --leg speculative --smoke
 	$(PY) bench.py --leg decode_attention --smoke
 
 demo: native

@@ -1044,6 +1044,95 @@ def bench_prefix_cache(smoke=False):
     }
 
 
+def bench_speculative(smoke=False):
+    """Speculative-decoding serving leg — prompt-lookup speculation inside
+    the paged ContinuousBatcher measured end-to-end on a REPETITIVE-TEXT
+    workload (the regime where bigram lookup hits: code, boilerplate,
+    templated documents — emulated by prompts that seed a repeating
+    phrase the greedy stream then cycles on). Drives the identical
+    workload spec-on (one multi-query verify dispatch per step,
+    committing 1..gamma+1 tokens/slot) and spec-off (one chunk of
+    single-token dispatches per step) and reports accept rate, committed
+    tokens per slot per verify dispatch, both tok/s figures and their
+    ratio, the rewound overshoot, and the token-identity bit the CI step
+    asserts (speculation must be a pure speedup, never a different
+    stream). On CPU (or --smoke) the model is tiny/f32 with the kernel
+    interpreted — numbers prove the leg end-to-end; the TPU run under
+    the driver is what BENCH_*.json captures."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if smoke or not on_tpu:
+        # f32 on CPU: the identity assert must see no bf16 near-tie noise
+        # between the 1-token and (1+gamma)-token program shapes.
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                                  decode_attn="fused")
+        n_req, phrase_len, reps, max_new, gamma = 8, 4, 3, 16, 4
+        eng_kw = dict(n_slots=4, max_len=96, chunk=4, prefill_bucket=16,
+                      page_size=8)
+    else:
+        # The long-context serving regime of the other legs, speculative
+        # edition: bf16 weights, int8 KV, fused kernels.
+        cfg = LlamaConfig(
+            vocab=32000, d_model=1024, n_layers=4, n_heads=16,
+            n_kv_heads=16, d_ff=4096, max_seq=2048, remat=False,
+            decode_attn="fused")
+        n_req, phrase_len, reps, max_new, gamma = 32, 16, 8, 64, 4
+        eng_kw = dict(n_slots=8, max_len=2048, chunk=8, prefill_bucket=128,
+                      page_size=64, kv_dtype="int8")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    workload = []
+    for _ in range(n_req):
+        phrase = list(rng.integers(0, cfg.vocab, phrase_len))
+        workload.append(phrase * reps)
+
+    def drive(spec: bool):
+        eng = ContinuousBatcher(params, cfg, kv_layout="paged",
+                                speculative=spec, gamma=gamma, **eng_kw)
+        # Warm OUTSIDE the measured window: compiles the prefill rung and
+        # the verify (or decode-chunk) program.
+        eng.submit(workload[0], max_new=2)
+        eng.run()
+        eng.pop_request_metrics()
+        t0 = time.perf_counter()
+        ids = [eng.submit(p, max_new=max_new) for p in workload]
+        done = eng.run()
+        wall = time.perf_counter() - t0
+        eng._alloc.assert_consistent()
+        return [done[i] for i in ids], wall, eng
+
+    toks_on, wall_on, eng_on = drive(True)
+    toks_off, wall_off, _ = drive(False)
+    m = eng_on.pool_metrics()
+    extra = {
+        "spec_shape": f"{n_req} reqs x ({phrase_len}-tok phrase x {reps}), "
+                      f"max_new {max_new}, gamma {gamma}",
+        "spec_interpret": not on_tpu,
+        "spec_accept_rate": round(m["spec_accept_rate"], 4),
+        "spec_tokens_per_dispatch": round(m["spec_tokens_per_dispatch"], 3),
+        "spec_rewound_tokens": m["spec_rewound_tokens_total"],
+        "spec_on_tok_s": round(n_req * max_new / wall_on, 1),
+        "spec_off_tok_s": round(n_req * max_new / wall_off, 1),
+        "spec_speedup": round(wall_off / wall_on, 3) if wall_on else None,
+        "spec_token_identity": toks_on == toks_off,
+    }
+    return {
+        "metric": "speculative_bench",
+        "value": extra["spec_tokens_per_dispatch"],
+        "unit": "tok/dispatch",
+        "extra": extra,
+    }
+
+
 def bench_analysis(smoke=False):
     """graftcheck latency leg: wall time of the analyzer over the whole
     repo, recorded in BENCH_r*.json so lint latency is a tracked metric —
@@ -1193,12 +1282,15 @@ def main(argv=None):
         if leg == "prefix_cache":
             print(json.dumps(bench_prefix_cache(smoke="--smoke" in args)))
             return
+        if leg == "speculative":
+            print(json.dumps(bench_speculative(smoke="--smoke" in args)))
+            return
         if leg == "analysis":
             print(json.dumps(bench_analysis(smoke="--smoke" in args)))
             return
         raise SystemExit(f"unknown bench leg: {leg!r} (available: "
                          f"decode_attention, paged_attention, prefix_cache, "
-                         f"analysis)")
+                         f"speculative, analysis)")
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
     # the bench measures the scheduler as deployed.
     sys.setswitchinterval(0.001)

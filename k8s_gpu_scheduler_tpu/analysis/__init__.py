@@ -35,6 +35,7 @@ from .astlint import lint_source, run_astlint
 from .vmem import (
     VMEM_BYTES_PER_CORE, audit_vmem, decode_attention_footprint,
     flash_attention_footprint, paged_decode_attention_footprint,
+    paged_verify_attention_footprint,
 )
 
 __all__ = [
@@ -49,6 +50,7 @@ __all__ = [
     "decode_attention_footprint",
     "flash_attention_footprint",
     "paged_decode_attention_footprint",
+    "paged_verify_attention_footprint",
     "audit_shared_pages",
     "check_shared_pages",
     "run_fast_passes",

@@ -107,6 +107,19 @@ def jaxpr_entrypoints() -> List[Tuple[str, Callable, tuple]]:
          peng._table_np.copy(), peng._lens, peng._last,
          np.asarray([True, False]), np.int32(2))))
 
+    # Speculative verify dispatch (the batched 1+gamma window program the
+    # spec batcher runs instead of the decode chunk — fused multi-query
+    # kernel + int8 pool, every operand class exercised).
+    seng = serving.ContinuousBatcher(
+        params, dataclasses.replace(cfg, decode_attn="fused"), n_slots=2,
+        max_len=32, chunk=2, prefill_bucket=4, kv_dtype="int8",
+        kv_layout="paged", page_size=8, speculative=True, gamma=2)
+    entries.append((
+        "batcher_verify_paged_spec", seng._decode,
+        (params, seng._k, seng._v, seng._ks, seng._vs,
+         seng._table_np.copy(), seng._lens, seng._last,
+         np.zeros((2, 2), np.int32), np.asarray([True, False]))))
+
     # Pipeline train step (pp >= 2 needs >= 2 local devices; conftest/CLI
     # request an 8-device CPU mesh before jax initializes).
     if len(jax.devices()) >= 2:
@@ -143,6 +156,15 @@ def jaxpr_entrypoints() -> List[Tuple[str, Callable, tuple]]:
     entries.append(("paged_decode_attention",
                     partial(paged_decode_attention, interpret=True),
                     (q, pool, pool, table, lengths)))
+
+    # Multi-query verify window over the same pool (t = 3 rows per slot,
+    # per-row causal bound — the speculative verify kernel).
+    from ..ops.decode_attention import paged_verify_attention
+
+    qv = jnp.zeros((2, 3, 8, 8), jnp.bfloat16)
+    entries.append(("paged_verify_attention",
+                    partial(paged_verify_attention, interpret=True),
+                    (qv, pool, pool, table, jnp.full((2,), 9, jnp.int32))))
     return entries
 
 
@@ -254,6 +276,47 @@ def _paged_prefix_batcher_scenario() -> tuple:
     return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
 
 
+def _paged_spec_batcher_scenario() -> tuple:
+    """Speculative edition of the paged scenario: steady-state VERIFY
+    dispatches across waves whose ACCEPT LENGTHS vary (self-repetitive
+    prompts cycle and accept multi-token prefixes; random prompts reject
+    everything — 0-accept full rewinds) must still be one compiled
+    program: the verify window pads to the fixed 1+gamma, the commit
+    length is a traced scalar, and the pool + table keep riding the
+    donation chain."""
+    import dataclasses
+
+    from ..models.serving import ContinuousBatcher
+
+    cfg, params = _tiny()
+    eng = ContinuousBatcher(params, dataclasses.replace(cfg,
+                                                        decode_attn="fused"),
+                            n_slots=2, max_len=48, chunk=2,
+                            prefill_bucket=8, kv_dtype="int8",
+                            kv_layout="paged", page_size=8,
+                            speculative=True, gamma=2)
+    rng = np.random.default_rng(0)
+    phrase = list(rng.integers(0, cfg.vocab, 3))
+
+    def warmup():
+        # Covers the prefill rung, the verify program under BOTH block-
+        # table jit keys (numpy upload on admission steps, committed
+        # device table on pure-verify steps), and a multi-step drain.
+        eng.submit(phrase * 2, max_new=4)
+        eng.run()
+
+    def wave(plen: int):
+        def go():
+            eng.submit(phrase * 2 + phrase[:plen - 6], max_new=3)
+            eng.submit(list(rng.integers(0, cfg.vocab, plen - 1)),
+                       max_new=2)
+            eng.run()
+        return go
+
+    steady = [wave(6), wave(7), wave(8)]
+    return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
+
+
 def _generate_scenario() -> tuple:
     import jax
     import jax.numpy as jnp
@@ -278,6 +341,7 @@ def recompile_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
         ("batcher_steady_decode", _batcher_scenario),
         ("batcher_steady_decode_paged", _paged_batcher_scenario),
         ("batcher_steady_decode_paged_prefix", _paged_prefix_batcher_scenario),
+        ("batcher_steady_decode_paged_spec", _paged_spec_batcher_scenario),
         ("generate_steady_state", _generate_scenario),
     ]
 
@@ -321,6 +385,21 @@ def donation_audit() -> List:
                                donated=(1, 2, 3, 4, 5),
                                name="batcher_decode_paged")
 
+    # Speculative verify: the same pool/scales/table donation contract as
+    # the decode chunk — the verify dispatch replaces it one-for-one in
+    # spec mode, so a copy here would double the pool per verify.
+    seng = ContinuousBatcher(params, cfg, n_slots=2, max_len=32, chunk=2,
+                             prefill_bucket=4, kv_dtype="int8",
+                             kv_layout="paged", page_size=8,
+                             speculative=True, gamma=2)
+    sargs = (params, seng._k, seng._v, seng._ks, seng._vs,
+             jnp.asarray(seng._table_np), jnp.zeros((2,), jnp.int32),
+             jnp.zeros((2,), jnp.int32), np.zeros((2, 2), np.int32),
+             np.asarray([True, True]))
+    findings += check_donation(seng._decode, *sargs,
+                               donated=(1, 2, 3, 4, 5),
+                               name="batcher_verify_paged_spec")
+
     # Tail prefill (prefix-cache hit shape): the pool + scale planes must
     # donate through the hb>0 program too — a copy here would double the
     # pool's HBM on every admission with a hit.
@@ -353,9 +432,9 @@ def donation_audit() -> List:
 
 # -- shared-page (copy-on-write) scenarios ------------------------------------
 
-def _prefix_engine():
+def _prefix_engine(speculative: bool = False):
     """A warmed prefix-cache engine with one donated prefix page and a
-    live request MOUNTING it: the state both alias scenarios audit
+    live request MOUNTING it: the state the alias scenarios audit
     against. Returns (engine, shared page ids)."""
     import dataclasses
 
@@ -367,7 +446,8 @@ def _prefix_engine():
                             n_slots=2, max_len=32, chunk=2,
                             prefill_bucket=8, kv_dtype="int8",
                             kv_layout="paged", page_size=8,
-                            prefix_cache=True)
+                            prefix_cache=True, speculative=speculative,
+                            gamma=2 if speculative else 4)
     rng = np.random.default_rng(0)
     sys_prefix = list(rng.integers(0, cfg.vocab, 8))
     eng.submit(sys_prefix + list(rng.integers(0, cfg.vocab, 3)), max_new=2)
@@ -412,10 +492,29 @@ def _alias_decode_scenario() -> tuple:
     return eng._decode, args, (1, 2, 3, 4), (0, 1, 2, 3), shared
 
 
+def _alias_verify_scenario() -> tuple:
+    """A speculative VERIFY dispatch over a block table whose prefix rows
+    are shared: the full 1+gamma window scatters at rows lens..lens+gamma
+    — including the up-to-gamma overshoot a rejection will rewind — and
+    every one of those rows must land past the mounted prefix. This is
+    the teeth behind the rewind contract: a lens clamp can only be a
+    correct rewind if the overshoot never touched a page another slot
+    (or the tree) can read."""
+    eng, shared = _prefix_engine(speculative=True)
+    props = np.zeros((2, eng.gamma), np.int32)
+    args = (eng.params, eng._k, eng._v, eng._ks, eng._vs,
+            eng._table_np.copy(), eng._lens, eng._last, props,
+            np.asarray([s in eng._slot_req for s in range(eng.n_slots)]))
+    # _decode (spec) returns (k, v, k_s, v_s, table, lens, last, toks,
+    # accepts).
+    return eng._decode, args, (1, 2, 3, 4), (0, 1, 2, 3), shared
+
+
 def alias_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
     """(name, build) pairs for the shared-page audit (analysis/alias.py):
     every real program that runs with aliased prefix pages in its pool."""
     return [
         ("batcher_prefill_paged_prefix", _alias_prefill_scenario),
         ("batcher_decode_paged_prefix", _alias_decode_scenario),
+        ("batcher_verify_paged_prefix", _alias_verify_scenario),
     ]

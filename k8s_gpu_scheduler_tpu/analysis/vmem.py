@@ -102,6 +102,33 @@ def decode_attention_footprint(
     )
 
 
+def _paged_kv_working_set(rows: int, page_size: int, hd: int,
+                          n_blocks: int, batch: int, kv_dtype: str,
+                          quant: bool, q_dtype: str) -> Tuple[int, int, int]:
+    """The shared VMEM accounting of BOTH paged kernels
+    (ops/decode_attention._paged_kernel and ._verify_kernel): a q block
+    of ``rows`` rows (g for decode, t·g for the verify window),
+    double-buffered k/v page blocks (int8 + f32 scale planes in quant
+    mode), three partial outputs, (acc, m, l) scratch, and the
+    scalar-prefetch working set — ``lengths`` [B] and the block table
+    [B, n_blocks] int32, resident for the whole kernel (SMEM-side, but
+    counted against the same budget conservatively). ONE definition so
+    the decode and verify estimates cannot drift apart. Returns
+    (in_blocks, out_blocks, scratch)."""
+    kv_d = "int8" if quant else kv_dtype
+    in_blocks = _nbytes((1, rows, hd), q_dtype) \
+        + 2 * _nbytes((1, page_size, 1, hd), kv_d)
+    if quant:
+        in_blocks += 2 * _nbytes((1, page_size, 1, 1), "float32")
+    out_blocks = _nbytes((1, 1, rows, hd), "float32") \
+        + 2 * _nbytes((1, 1, rows, _LANES), "float32")
+    scratch = _nbytes((rows, hd), "float32") \
+        + 2 * _nbytes((rows, _LANES), "float32")
+    scratch += _nbytes((batch,), "int32") \
+        + _nbytes((batch, n_blocks), "int32")        # scalar prefetch
+    return in_blocks, out_blocks, scratch
+
+
 def paged_decode_attention_footprint(
     page_size: int, g: int, hd: int, n_blocks: int, batch: int = 8,
     kv_dtype: str = "bfloat16", quant: bool = False,
@@ -109,29 +136,44 @@ def paged_decode_attention_footprint(
 ) -> KernelFootprint:
     """Working set of ops/decode_attention._paged_kernel for one grid
     program: the page IS the kv block, so the VMEM picture matches the
-    contiguous kernel at block_k == page_size (q block, double-buffered
-    k/v page blocks, int8 scale planes in quant mode, three partial
-    outputs, (acc, m, l) scratch) — no bitmap operand (the per-slot
-    length bound subsumes it in the paged design) — PLUS the scalar-
-    prefetch working set: ``lengths`` [B] and the block table
-    [B, n_blocks] int32, resident for the whole kernel (SMEM-side, but
-    counted against the same budget conservatively)."""
-    kv_d = "int8" if quant else kv_dtype
-    in_blocks = _nbytes((1, g, hd), q_dtype) \
-        + 2 * _nbytes((1, page_size, 1, hd), kv_d)
-    if quant:
-        in_blocks += 2 * _nbytes((1, page_size, 1, 1), "float32")
-    out_blocks = _nbytes((1, 1, g, hd), "float32") \
-        + 2 * _nbytes((1, 1, g, _LANES), "float32")
-    scratch = _nbytes((g, hd), "float32") + 2 * _nbytes((g, _LANES), "float32")
-    scratch += _nbytes((batch,), "int32") \
-        + _nbytes((batch, n_blocks), "int32")        # scalar prefetch
+    contiguous kernel at block_k == page_size — no bitmap operand (the
+    per-slot length bound subsumes it in the paged design) — plus the
+    block-table scalar working set (see _paged_kv_working_set)."""
+    in_blocks, out_blocks, scratch = _paged_kv_working_set(
+        g, page_size, hd, n_blocks, batch, kv_dtype, quant, q_dtype)
     return KernelFootprint(
         name=f"paged_decode(ps={page_size}, n_blocks={n_blocks}, g={g}, "
              f"hd={hd}, kv={'int8' if quant else kv_dtype})",
         in_blocks=in_blocks, out_blocks=out_blocks, scratch=scratch,
         notes=f"page_size={page_size}, double-buffered page blocks + "
               f"[B,{n_blocks}] block table",
+    )
+
+
+def paged_verify_attention_footprint(
+    page_size: int, g: int, hd: int, n_blocks: int, t: int, batch: int = 8,
+    kv_dtype: str = "bfloat16", quant: bool = False,
+    q_dtype: str = "bfloat16",
+) -> KernelFootprint:
+    """Working set of ops/decode_attention._verify_kernel for one grid
+    program — the multi-query speculative verify window. The kv side is
+    the paged decode picture unchanged (the page is the kv block,
+    double-buffered, int8 scale planes in quant mode, the [B, n_blocks]
+    block-table scalar working set); the Q-WINDOW ROWS MULTIPLY the
+    query/output/scratch side: q block [1, t·g, hd], three partial
+    outputs and the (acc, m, l) scratch all carry t·g rows instead of g.
+    That factor is how a \"just raise gamma\" tuning mistake walks the
+    kernel over the budget while the kv traffic looks unchanged — the
+    exact cliff this estimator exists to catch before Mosaic does."""
+    rows = t * g
+    in_blocks, out_blocks, scratch = _paged_kv_working_set(
+        rows, page_size, hd, n_blocks, batch, kv_dtype, quant, q_dtype)
+    return KernelFootprint(
+        name=f"paged_verify(ps={page_size}, n_blocks={n_blocks}, t={t}, "
+             f"g={g}, hd={hd}, kv={'int8' if quant else kv_dtype})",
+        in_blocks=in_blocks, out_blocks=out_blocks, scratch=scratch,
+        notes=f"page_size={page_size}, t*g={rows} q-window rows multiply "
+              f"the q/out/scratch set",
     )
 
 
@@ -204,6 +246,11 @@ def audit_vmem(budget: int = VMEM_BYTES_PER_CORE) -> List[Finding]:
 
     findings: List[Finding] = []
     anchor = "k8s_gpu_scheduler_tpu/ops/decode_attention.py"
+    # Speculation windows the serving engine actually dispatches
+    # (ContinuousBatcher speculative=True / generate_speculative): the
+    # verify kernel's q side scales with t = 1+gamma, so every preset is
+    # checked at the realistic gamma range too.
+    gammas = (2, 4)
     for name, cfg, meta in _presets():
         g = cfg.n_heads // cfg.n_kv_heads
         for s in meta["cache_lens"]:
@@ -237,6 +284,11 @@ def audit_vmem(budget: int = VMEM_BYTES_PER_CORE) -> List[Finding]:
                     fp = paged_decode_attention_footprint(
                         ps, g, cfg.head_dim, s // ps, quant=quant)
                     findings.extend(fp.check(budget, anchor=anchor))
+                    for gamma in gammas:
+                        fp = paged_verify_attention_footprint(
+                            ps, g, cfg.head_dim, s // ps, 1 + gamma,
+                            quant=quant)
+                        findings.extend(fp.check(budget, anchor=anchor))
         # Training flash attention at max_seq (forward defaults 256/512;
         # backward shrinks to <=256 divisors — mirror _resolve/_bwd).
         t = cfg.max_seq

@@ -197,6 +197,11 @@ SERVING_POOL_GAUGES = {
     "prefix_inserted_pages": "cumulative pages adopted into the tree",
     "prefix_evictions": "cumulative prefix-cache pages evicted (LRU)",
     "prefill_tokens_skipped": "prefill rows skipped via prefix reuse",
+    "spec_accept_rate": "speculative proposals accepted / proposed",
+    "spec_tokens_per_dispatch":
+        "tokens committed per active slot per verify dispatch",
+    "spec_rewound_tokens_total":
+        "cumulative rejected overshoot rows rewound by the lens clamp",
 }
 
 

@@ -42,9 +42,12 @@ class LlamaConfig:
     # Serving decode-attention path (models/serving.py): "fused" streams
     # the KV cache through the Pallas flash-decode kernel
     # (ops/decode_attention.py — in-kernel GQA, fused int8-KV dequant,
-    # O(pos) length-masked reads); "dense" keeps the grouped-einsum
+    # O(pos) length-masked reads); speculative verify windows (t =
+    # 1+gamma) fuse too, through the multi-query variant
+    # (paged_verify_attention). "dense" keeps the grouped-einsum
     # reference. Fused falls back to dense automatically when the cache
-    # length has no legal blocking, t > 1, or the cache is mesh-sharded.
+    # length has no legal blocking, t > 1 outside a verify window
+    # (prefill), or the cache is mesh-sharded.
     decode_attn: str = "dense"
     remat: bool = True
     # Mixture-of-Experts (ops/moe.py): n_experts 0 = dense FFN; > 1 swaps

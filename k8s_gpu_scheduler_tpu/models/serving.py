@@ -35,8 +35,14 @@ SHARED-PREFIX radix cache over the paged pool (``prefix_cache=True``,
 models/prefix_cache.py: reaped prompts donate their full pages into a
 token-chunk tree, admission mounts the longest cached prefix read-only
 and prefills only the novel tail — ref-counted pages, copy-on-write at
-page granularity, LRU eviction) — and ``generate_speculative``
-(prompt-lookup speculation, draft-model-free).
+page granularity, LRU eviction) — and SPECULATIVE DECODING, two ways:
+``generate_speculative`` (single-request prompt-lookup speculation,
+draft-model-free — the reference implementation) and the paged batcher's
+``speculative=True`` (per-slot prompt-lookup proposals on the host token
+mirror, one batched multi-query verify dispatch over all slots through
+``ops.paged_verify_attention``, vectorized accept/reject, rewind by
+clamping each slot's ``lens`` — up to gamma+1 committed tokens per slot
+per dispatch).
 
 The reference has no serving engine at all (it schedules inference pods,
 SURVEY.md §0); this is the workload side of BASELINE config 5
@@ -55,9 +61,10 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.decode_attention import (
-    DEFAULT_PAGE_SIZE, decode_plan, dense_decode_reference,
-    flash_decode_attention, gather_paged_kv, paged_decode_attention,
-    paged_plan,
+    DEFAULT_PAGE_SIZE, contiguous_as_paged, decode_plan,
+    dense_decode_reference, dense_verify_reference, flash_decode_attention,
+    gather_paged_kv, paged_decode_attention, paged_plan,
+    paged_verify_attention, verify_plan,
 )
 from ..ops.layers import apply_rope, rms_norm, rope_freqs
 from ..ops.quant import qdot
@@ -85,7 +92,8 @@ def init_cache(cfg: LlamaConfig, batch: int,
 
 def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      pos: jax.Array, impl: str = "dense",
-                     interpret: Optional[bool] = None) -> jax.Array:
+                     interpret: Optional[bool] = None,
+                     verify: bool = False) -> jax.Array:
     """Attention of q [B, t, H, hd] (absolute positions pos..pos+t-1)
     against the cache [B, S, Hkv, hd], masked to entries < pos+t with
     causal order inside the new window.
@@ -93,9 +101,13 @@ def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     ``impl="fused"`` routes the decode shape (t == 1) through the Pallas
     flash-decode kernel (ops/decode_attention.py): cache rows stream
     through VMEM once with in-kernel GQA and blocks past ``pos`` skipped,
-    so the step costs O(pos) HBM traffic instead of O(max_seq). Shapes the
-    kernel's blocking cannot cover — and every t > 1 call (prefill,
-    speculative verify) — fall back automatically to the dense path, which
+    so the step costs O(pos) HBM traffic instead of O(max_seq).
+    ``verify=True`` extends the fused route to t > 1 — the speculative
+    1+gamma verify window — through the MULTI-QUERY kernel
+    (ops.paged_verify_attention), the contiguous cache viewed as a paged
+    pool with an iota block table (contiguous_as_paged: a reshape, no
+    copy). Shapes the blocking cannot cover — and every other t > 1 call
+    (prefill) — fall back automatically to the dense path, which
     contracts through a grouped [B, Hkv, g, ...] head axis rather than
     materializing an H/Hkv-times `_repeat_kv` copy of the cache."""
     b, t, n_heads, d = q.shape
@@ -105,6 +117,14 @@ def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         out = flash_decode_attention(
             q[:, 0], k_cache, v_cache, pos + 1, interpret=interpret)
         return out[:, None]
+    if impl == "fused" and verify and t > 1 and n_heads % h_kv == 0 \
+            and decode_plan(s) is not None:
+        block_k = decode_plan(s)[0]
+        if verify_plan(s // block_k, block_k, t) is not None:
+            kp, table = contiguous_as_paged(k_cache, block_k)
+            vp, _ = contiguous_as_paged(v_cache, block_k)
+            return paged_verify_attention(q, kp, vp, table, pos,
+                                          interpret=interpret)
     g = n_heads // h_kv
     qg = q.reshape(b, t, h_kv, g, d)
     scale = 1.0 / (d ** 0.5)
@@ -121,11 +141,16 @@ def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 def forward_with_cache(
     params: Dict, tokens: jax.Array, cfg: LlamaConfig,
     cache: Dict[str, jax.Array], mesh: Optional[Mesh] = None,
+    verify: bool = False,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """tokens [B, t] starting at absolute position cache["len"] →
     (logits [B, t, vocab], updated cache). t is static (prefill: prompt
     length; decode: 1); the position is traced, so both programs compile
-    once and serve any request length ≤ max_seq. MoE configs route
+    once and serve any request length ≤ max_seq. ``verify=True`` marks a
+    speculative 1+gamma verify window, letting ``decode_attn="fused"``
+    route the t > 1 attention through the multi-query kernel instead of
+    the dense fallback (prefill calls stay dense — the flag is how the
+    two t > 1 shapes are told apart). MoE configs route
     DROPLESS (mlp_sublayer dropless=True): at inference a capacity drop
     would make a request's completion depend on co-batched tokens and on
     prefill padding, so serving output is a per-token function; it matches
@@ -151,7 +176,8 @@ def forward_with_cache(
         q, k = apply_rope(q, angles), apply_rope(k, angles)
         k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
         v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
-        attn = cached_attention(q, k_cache, v_cache, pos, impl=attn_impl)
+        attn = cached_attention(q, k_cache, v_cache, pos, impl=attn_impl,
+                                verify=verify)
         x = x + qdot(attn.reshape(B, t, cfg.n_heads * cfg.head_dim), blk["wo"])
         x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
         return x, (k_cache, v_cache)
@@ -222,10 +248,18 @@ def generate_speculative(
     pass, never below.
 
     Single request only (B=1): acceptance length varies per row, which a
-    batch cannot share. The cache rewind is safe because stale rows past
-    the rewound ``len`` sit inside the NEXT verify's write window
-    (width 1+gamma at the new position), and forward_with_cache writes
-    each row before any query can attend it.
+    batch cannot share — the REFERENCE implementation; the paged
+    ContinuousBatcher (``speculative=True``) runs the same propose/verify
+    /accept loop across every slot at once. The cache rewind is safe
+    because stale rows past the rewound ``len`` sit inside the NEXT
+    verify's write window (width 1+gamma at the new position), and
+    forward_with_cache writes each row before any query can attend it.
+
+    With ``cfg.decode_attn="fused"`` the (1+gamma)-token verify pass runs
+    through the multi-query Pallas kernel (ops.paged_verify_attention via
+    ``verify=True`` — it previously fell back to the dense path, leaving
+    speculation off the fused hot path); dense configs keep the dense
+    verify, token-identical either way up to float near-ties.
     """
     B, t_prompt = prompt.shape
     if B != 1:
@@ -269,7 +303,8 @@ def generate_speculative(
         prop = propose(seq, n)
         last = jax.lax.dynamic_slice(seq, (0, n - 1), (1, 1))
         x = jnp.concatenate([last, prop], axis=1)    # [1, 1+gamma]
-        logits, cache = forward_with_cache(params, x, cfg, cache)
+        logits, cache = forward_with_cache(params, x, cfg, cache,
+                                           verify=True)
         greedy = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # [1+gamma]
         accept = jnp.cumprod(
             (prop[0] == greedy[:-1]).astype(jnp.int32)).sum()
@@ -676,6 +711,119 @@ def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
     return k, v, k_s, v_s, table, lens, last, jnp.swapaxes(toks, 0, 1)
 
 
+def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
+                           page_size: int, k, v, table, lens, last, props,
+                           active, k_s=None, v_s=None):
+    """One batched speculative VERIFY dispatch over every slot of the
+    paged pool: score the t = 1+gamma window [last, props...] of each
+    active slot in a single forward, accept the longest proposal prefix
+    agreeing with the verify pass's own greedy argmax, and commit exactly
+    the accepted tokens — the multi-slot analog of generate_speculative's
+    loop body, with pages as the rewind unit.
+
+    The window's K/V rows scatter at logical rows lens..lens+gamma of
+    each slot BEFORE attention (the same write-then-attend order as the
+    decode step, t rows at once); attention is the multi-query kernel
+    (ops.paged_verify_attention — per-row causal bound lens+i+1) or the
+    gathered dense verify reference. ``lens`` then advances by the TRACED
+    commit length accept+1 only: the up-to-gamma rejected overshoot rows
+    sit above the new lens — inside the slot's own reserved pages, since
+    admission reserves the gamma overshoot too (_rows_needed) — masked by
+    every later read until the next verify window overwrites them
+    (new window = rows lens'..lens'+gamma ⊇ the stale extent). That lens
+    clamp IS the rewind: no page moves, no shared prefix page is ever
+    touched (writes land at rows >= lens >= hit_len — the copy-on-write
+    argument of the decode scatter, verbatim, enforced by the graftcheck
+    alias scenario).
+
+    Inactive slots redirect their window writes to the null page and
+    carry lens/last through. Greedy-only by construction (acceptance is
+    exact-match against argmax; the batcher rejects speculative+sampling
+    at __init__), so no PRNG state rides along. Returns the donated pool
+    /scale/table chain plus per-slot ``emitted`` [B, 1+gamma] (-1 past
+    the commit length and for inactive slots) and ``accepts`` [B] (the
+    number of PROPOSALS accepted, 0..gamma)."""
+    quant = k_s is not None
+    B = last.shape[0]
+    t = 1 + gamma
+    n_blocks = table.shape[1]
+    S = n_blocks * page_size
+    fused = (getattr(cfg, "decode_attn", "dense") == "fused"
+             and cfg.n_heads % cfg.n_kv_heads == 0
+             and verify_plan(n_blocks, page_size, t) is not None)
+    angles_full = rope_freqs(cfg.head_dim, S, cfg.rope_theta)
+    row_ids = jnp.arange(B)
+    active_i = jnp.asarray(active)
+    window = jnp.concatenate(
+        [last[:, None], jnp.asarray(props, last.dtype)], axis=1)  # [B, t]
+    # Physical addresses of the window rows: active slots append at
+    # logical rows lens..lens+gamma; inactive slots (stale lens, possibly
+    # at capacity — gathers clamp) redirect to the null page.
+    pos = lens[:, None] + jnp.arange(t, dtype=lens.dtype)[None, :]  # [B, t]
+    pg = table[row_ids[:, None],
+               jnp.minimum(pos // page_size, n_blocks - 1)]
+    off = pos % page_size
+    pg_w = jnp.where(active_i[:, None], pg, NULL_PAGE)
+    off_w = jnp.where(active_i[:, None], off, 0)
+    angles = angles_full[jnp.minimum(pos, S - 1)]        # [B, t, hd/2]
+    x = params["embed"][window].astype(cfg.dtype)        # [B, t, D]
+
+    def block(x, layer):
+        blk, k_pg, v_pg, ks_p, vs_p = layer      # [n_pages, ps, Hkv, hd]
+        h = rms_norm(x, blk["attn_norm"])
+        q = qdot(h, blk["wq"]).reshape(B, t, cfg.n_heads, cfg.head_dim)
+        kk = qdot(h, blk["wk"]).reshape(B, t, cfg.n_kv_heads, cfg.head_dim)
+        vv = qdot(h, blk["wv"]).reshape(B, t, cfg.n_kv_heads, cfg.head_dim)
+        q, kk = apply_rope(q, angles), apply_rope(kk, angles)
+        if quant:
+            kq, ksn = _kv_quant(kk)
+            vq, vsn = _kv_quant(vv)
+            k_pg = k_pg.at[pg_w, off_w].set(kq)
+            v_pg = v_pg.at[pg_w, off_w].set(vq)
+            ks_p = ks_p.at[pg_w, off_w].set(ksn)
+            vs_p = vs_p.at[pg_w, off_w].set(vsn)
+        else:
+            k_pg = k_pg.at[pg_w, off_w].set(kk)
+            v_pg = v_pg.at[pg_w, off_w].set(vv)
+        scales = dict(k_scale=ks_p, v_scale=vs_p) if quant else {}
+        if fused:
+            # Multi-query streamed kernel: per-row causal bound inside
+            # the window, blocks past lens+t skipped — O(pos) traffic
+            # for the whole window in one sweep of the cache.
+            attn = paged_verify_attention(q, k_pg, v_pg, table, lens,
+                                          **scales)
+        else:
+            dsc = {}
+            if quant:
+                dsc = dict(k_scale=gather_paged_kv(ks_p, table),
+                           v_scale=gather_paged_kv(vs_p, table))
+            attn = dense_verify_reference(
+                q, gather_paged_kv(k_pg, table),
+                gather_paged_kv(v_pg, table), lens, **dsc)
+        x = x + qdot(attn.reshape(B, t, cfg.n_heads * cfg.head_dim),
+                     blk["wo"])
+        x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
+        return x, (k_pg, v_pg, ks_p, vs_p)
+
+    x, (k, v, k_s, v_s) = jax.lax.scan(
+        block, x, (params["blocks"], k, v, k_s, v_s))
+    x = rms_norm(x, params["final_norm"])
+    logits = qdot(x, params["lm_head"]).astype(jnp.float32)  # [B, t, vocab]
+    greedy = jnp.argmax(logits, axis=-1).astype(last.dtype)  # [B, t]
+    # Longest agreeing proposal prefix, exactly generate_speculative's
+    # accept rule, vectorized over slots.
+    hits = (window[:, 1:] == greedy[:, :-1]).astype(jnp.int32)
+    accepts = jnp.cumprod(hits, axis=1).sum(axis=1)          # [B] 0..gamma
+    commit = jnp.arange(t)[None, :] <= accepts[:, None]      # [B, t]
+    emitted = jnp.where(commit & active_i[:, None], greedy,
+                        jnp.full_like(greedy, -1))
+    new_last = jnp.take_along_axis(greedy, accepts[:, None], axis=1)[:, 0]
+    last = jnp.where(active_i, new_last, last)
+    lens = lens + jnp.where(active_i, accepts + 1, 0).astype(lens.dtype)
+    accepts = jnp.where(active_i, accepts, 0)
+    return k, v, k_s, v_s, table, lens, last, emitted, accepts
+
+
 def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
                             k, v, lens, last, slots, page_ids,
                             prefix_tables, hit_lens, tokens, tail_lens,
@@ -837,7 +985,24 @@ class ContinuousBatcher:
     ``kv_layout="paged"`` swaps the shared-cursor contiguous cache for the
     paged pool + block table (see the section comment above): admission
     needs free PAGES instead of a contiguous cursor window, finished
-    requests free theirs immediately, and there is no epoch roll."""
+    requests free theirs immediately, and there is no epoch roll.
+
+    ``speculative=True`` (paged + greedy only) lifts prompt-lookup
+    speculation out of ``generate_speculative`` into the batcher: each
+    step proposes ``gamma`` tokens per slot by bigram match on the host
+    token mirror (prompt + emitted stream), verifies every slot's
+    1+gamma window in ONE batched dispatch (_verify_chunk_paged_fn), and
+    commits the agreeing prefix — up to gamma+1 tokens per slot per
+    dispatch on self-repetitive text, never below 1. Rewind is free:
+    rejected overshoot rows sit above the committed ``lens`` inside the
+    slot's own reserved pages (admission reserves the gamma window —
+    _rows_needed), so no page ever moves and shared prefix pages are
+    never touched. Verify windows pad to the fixed 1+gamma and the
+    commit length is traced, so steady-state decode stays zero-retrace
+    with the pool/scales/table donated every dispatch. Acceptance is
+    content-dependent (the host must see each step's tokens to propose
+    the next), so speculative steps flush per dispatch like eos mode —
+    the deferred-drain fast path doesn't apply."""
 
     def __init__(self, params, cfg: LlamaConfig, n_slots: int = 8,
                  max_len: Optional[int] = None, chunk: int = 8,
@@ -847,7 +1012,8 @@ class ContinuousBatcher:
                  kv_layout: str = "contiguous",
                  page_size: int = DEFAULT_PAGE_SIZE,
                  n_pages: Optional[int] = None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 speculative: bool = False, gamma: int = 4):
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -884,6 +1050,32 @@ class ContinuousBatcher:
             raise ValueError(f"top_k {self.top_k} exceeds vocab {cfg.vocab}")
         self._dispatch_no = 0
         self._eos_scanned: Dict[int, int] = {}       # req id -> tokens scanned
+        self.spec = bool(speculative)
+        self.gamma = int(gamma)
+        if self.spec:
+            if kv_layout != "paged":
+                raise ValueError(
+                    "speculative=True requires kv_layout='paged' (rewind "
+                    "is a lens clamp inside the slot's own pages)")
+            if self.temperature > 0:
+                raise ValueError(
+                    "speculative decode is greedy-only (acceptance is "
+                    "exact-match against the verify argmax); temperature "
+                    "must be 0")
+            if self.gamma < 1:
+                raise ValueError(f"gamma must be >= 1, got {gamma}")
+            # Speculation gauges (pool_metrics → tpu_serve_spec_*): how
+            # many proposals each verify accepted, tokens committed per
+            # active slot per dispatch, and the overshoot rows rewound.
+            self._spec_dispatches = 0
+            self._spec_slot_steps = 0
+            self._spec_proposed = 0
+            self._spec_accepted = 0
+            self._spec_emitted = 0
+            self._spec_rewound = 0
+            # Per-slot proposal mirror: (rid, hist, bigram→latest index),
+            # grown incrementally as tokens commit (see _propose).
+            self._spec_mirror = {}
         self.S = min(max_len or cfg.max_seq, cfg.max_seq)
         if kv_layout == "paged":
             if mesh is not None:
@@ -983,13 +1175,27 @@ class ContinuousBatcher:
         temp, tk = self.temperature, self.top_k
         if kv_layout == "paged":
             ps = self.page_size
-            self._decode = jax.jit(
-                lambda p, k, v, ks, vs, tbl, lens, last, active, seed:
-                _decode_chunk_paged_fn(
-                    p, cfg, chunk, ps, k, v, tbl, lens, last, active, seed,
-                    temp, tk, k_s=ks, v_s=vs),
-                donate_argnums=(1, 2, 3, 4, 5),
-            )
+            if self.spec:
+                gm = self.gamma
+                # The verify dispatch replaces the decode chunk: one
+                # (1+gamma)-window forward per step instead of `chunk`
+                # single-token ticks; the donation contract is identical
+                # (pool + scales + table consumed every dispatch).
+                self._decode = jax.jit(
+                    lambda p, k, v, ks, vs, tbl, lens, last, props, active:
+                    _verify_chunk_paged_fn(
+                        p, cfg, gm, ps, k, v, tbl, lens, last, props,
+                        active, k_s=ks, v_s=vs),
+                    donate_argnums=(1, 2, 3, 4, 5),
+                )
+            else:
+                self._decode = jax.jit(
+                    lambda p, k, v, ks, vs, tbl, lens, last, active, seed:
+                    _decode_chunk_paged_fn(
+                        p, cfg, chunk, ps, k, v, tbl, lens, last, active,
+                        seed, temp, tk, k_s=ks, v_s=vs),
+                    donate_argnums=(1, 2, 3, 4, 5),
+                )
             self._prefill = jax.jit(
                 lambda p, k, v, ks, vs, lens, last, slots, pids, ptbl,
                 hlens, tokens, tlens, seed: _prefill_multi_paged_fn(
@@ -1069,8 +1275,15 @@ class ContinuousBatcher:
     def _rows_needed(self, budget: int) -> int:
         """Worst-case cursor rows a request still needs: its remaining
         decode steps, rounded up to whole chunks (the shared cursor
-        advances chunk rows per dispatch)."""
+        advances chunk rows per dispatch). Speculative mode commits at
+        most one row per emitted token (budget - 1 rows) but each verify
+        writes the full 1+gamma window, so up to gamma rejected overshoot
+        rows can sit above the last committed lens — reserving them here
+        is what makes rewind a free lens clamp inside the slot's own
+        pages (never a shared prefix page, never an allocation)."""
         steps = max(0, budget - 1)                   # first token = prefill
+        if self.spec:
+            return steps + self.gamma
         return -(-steps // self.chunk) * self.chunk
 
     @staticmethod
@@ -1114,6 +1327,8 @@ class ContinuousBatcher:
         of one per chunk (the per-step readback was 98% of the serving
         bench — 0.88 s of a 0.90 s run — with dispatches at ~3 ms)."""
         if self.layout == "paged":
+            if self.spec:
+                return self._step_spec_paged()
             return self._step_lazy_paged()
         if not self._slot_req and self._cursor:
             # Epoch roll: every slot drained — reclaim the cursor space.
@@ -1218,11 +1433,12 @@ class ContinuousBatcher:
     # -- paged step --------------------------------------------------------
     def _pages_needed(self, prompt_len: int, budget: int) -> int:
         """Worst-case pages a request can ever touch: its prompt rows plus
-        the chunk-rounded decode rows (the device writes whole chunks for
-        active slots — see _rows_needed), page-granular. Reserved in FULL
-        at admission so a request in flight never stalls on allocation
-        (no mid-decode deadlock); eos early-stop returns the unused tail
-        at finish."""
+        the decode rows — chunk-rounded in plain mode (the device writes
+        whole chunks for active slots), budget + the gamma verify-window
+        overshoot in speculative mode (see _rows_needed for both
+        formulas) — page-granular. Reserved in FULL at admission so a
+        request in flight never stalls on allocation (no mid-decode
+        deadlock); eos early-stop returns the unused tail at finish."""
         return -(-(prompt_len + self._rows_needed(budget)) // self.page_size)
 
     def _hb_bucket(self, n_hit_pages: int) -> int:
@@ -1261,13 +1477,16 @@ class ContinuousBatcher:
         self._table_np[slot] = NULL_PAGE
         self._table_dirty = True
 
-    def _step_lazy_paged(self) -> list:
-        """The paged-analog of _step_lazy: admission takes free PAGES
-        wherever they are (no contiguous window, no backward-write trick),
-        so the only admission gates are a free slot, free pages, and
-        strict FCFS — and there is NO epoch roll: freed pages recycle
-        immediately, so the all-slots-drained idle boundary the cursor
-        design pays every ~S decode steps simply does not exist."""
+    def _admit_paged(self) -> list:
+        """Paged admission: take free PAGES wherever they are (no
+        contiguous window, no backward-write trick), so the only gates
+        are a free slot, free pages, and strict FCFS — and there is NO
+        epoch roll: freed pages recycle immediately, so the
+        all-slots-drained idle boundary the cursor design pays every ~S
+        decode steps simply does not exist. Dispatches the padded
+        prefill runs; returns the max_new==1 requests that already
+        finished. Shared by the plain decode step (_step_lazy_paged) and
+        the speculative verify step (_step_spec_paged)."""
         finished: list = []
         free = [s for s in range(self.n_slots) if s not in self._slot_req]
         adm: list = []           # (req id, slot, pages, prompt, bucket, hits)
@@ -1382,17 +1601,25 @@ class ContinuousBatcher:
                 ("firsts", firsts_arr, [rid for rid, *_ in run]))
         for pages, hits, prompt in free_after:
             self._retire_pages(pages, hits, prompt)
+        return finished
 
+    def _device_table(self):
+        """Upload the block table only when admissions/frees changed it
+        (a copy, so the donated device buffer never aliases the live
+        mirror); otherwise the previous dispatch's donated-through table
+        is passed straight back — zero-copy steady state."""
+        table = self._table_np.copy() if self._table_dirty else self._table
+        self._table_dirty = False
+        return table
+
+    def _step_lazy_paged(self) -> list:
+        """Admit (see _admit_paged), then dispatch one decode chunk."""
+        finished = self._admit_paged()
         if not self._slot_req:
             return finished
         active = np.asarray(
             [s in self._slot_req for s in range(self.n_slots)])
-        # Upload the table only when admissions/frees changed it (a copy,
-        # so the donated device buffer never aliases the live mirror);
-        # otherwise the previous dispatch's donated-through table is
-        # passed straight back — zero-copy steady state.
-        table = self._table_np.copy() if self._table_dirty else self._table
-        self._table_dirty = False
+        table = self._device_table()
         self._dispatch_no += 1
         (self._k, self._v, self._ks, self._vs, self._table, self._lens,
          self._last, toks) = self._decode(
@@ -1413,6 +1640,101 @@ class ContinuousBatcher:
         self._reads.append(("chunk", toks, takes))
         return finished
 
+    def _mirror_append(self, hist: list, idx: dict, tk: int) -> None:
+        """Grow a slot's proposal mirror by one committed token, keeping
+        the bigram index's DEFERRED-TAIL invariant: the bigram ending at
+        the current tail is recorded only once a token lands after it, so
+        a lookup of the tail bigram always answers with the latest
+        *previous* occurrence — exactly the `j <= n-2` bound of the
+        linear-scan rule this index replaces."""
+        if len(hist) >= 2:
+            idx[(hist[-2], hist[-1])] = len(hist) - 1
+        hist.append(tk)
+
+    def _propose(self, slot: int, rid: int) -> list:
+        """Prompt-lookup proposal for one slot: gamma tokens guessed by
+        the LATEST bigram match against the slot's committed stream
+        (prompt + emitted tokens — generate_speculative's rule on the
+        host mirror instead of the device buffer). No match → zeros;
+        garbage guesses are simply rejected by the verify, costing
+        nothing beyond the window the dispatch pads to anyway.
+
+        The match is served by a per-slot incremental bigram → latest-
+        position index instead of a backward scan, so steady-state cost
+        is O(tokens committed since the last dispatch) = O(gamma) — a
+        long non-repetitive stream (where speculation pays nothing) no
+        longer inserts an O(history) Python loop between the synchronous
+        verify dispatches. The index rebuilds from the prompt when the
+        slot changes hands (O(prompt), once per admission)."""
+        g = self.gamma
+        mirror = self._spec_mirror.get(slot)
+        if mirror is None or mirror[0] != rid:       # slot reassigned
+            mirror = (rid, [], {})
+            self._spec_mirror[slot] = mirror
+            for tk in self._slot_prompt[slot]:
+                self._mirror_append(mirror[1], mirror[2], int(tk))
+        _, hist, idx = mirror
+        base = len(self._slot_prompt[slot])
+        for tk in self._out[rid][len(hist) - base:]:
+            self._mirror_append(hist, idx, int(tk))
+        j = idx.get((hist[-2], hist[-1]))
+        if j is None:
+            return [0] * g
+        guess = [int(tk) for tk in hist[j + 1:j + 1 + g]]
+        return guess + [0] * (g - len(guess))
+
+    def _step_spec_paged(self) -> list:
+        """Speculative analog of _step_lazy_paged: admit, then ONE
+        batched verify dispatch over all active slots — each commits
+        1..gamma+1 tokens. Content-dependent by nature (the next
+        proposal needs this step's committed tokens on the host), so the
+        step flushes and reads the verify back synchronously instead of
+        deferring to the drain — the same trade eos mode makes."""
+        finished = self._admit_paged()
+        if not self._slot_req:
+            return finished
+        # Proposals read the committed stream, so the prefill firsts of
+        # requests admitted THIS step must be host-visible first (this
+        # also keeps per-request token order intact: firsts land in
+        # _out before the verify's direct appends below).
+        self._flush()
+        props = np.zeros((self.n_slots, self.gamma), np.int32)
+        for slot, rid in self._slot_req.items():
+            props[slot] = self._propose(slot, rid)
+        active = np.asarray(
+            [s in self._slot_req for s in range(self.n_slots)])
+        table = self._device_table()
+        self._dispatch_no += 1
+        (self._k, self._v, self._ks, self._vs, self._table, self._lens,
+         self._last, toks, accepts) = self._decode(
+            self.params, self._k, self._v, self._ks, self._vs, table,
+            self._lens, self._last, props, active)
+        # graftcheck: ignore[host-sync] — sanctioned: speculative scheduling is content-dependent (accept lengths gate budgets and the next proposals), one readback per verify dispatch by design
+        toks, accepts = jax.device_get((toks, accepts))
+        self._spec_dispatches += 1
+
+        for slot, req_id in list(self._slot_req.items()):
+            acc = int(accepts[slot])
+            take = min(self._budget[req_id], acc + 1)
+            self._out[req_id].extend(int(tk) for tk in toks[slot, :take])
+            # Gauges count what the stream actually kept: on a finishing
+            # dispatch the budget clamp discards accepted-but-over-budget
+            # proposals, and those rows are rewound like any rejection —
+            # keeps accept_rate and tokens_per_dispatch telling one story.
+            used = take - 1
+            self._spec_slot_steps += 1
+            self._spec_proposed += self.gamma
+            self._spec_accepted += used
+            self._spec_emitted += take
+            self._spec_rewound += self.gamma - used
+            self._budget[req_id] -= take
+            if self._budget[req_id] <= 0:
+                finished.append(req_id)
+                del self._budget[req_id]
+                del self._slot_req[slot]             # slot free NOW
+                self._free_slot_pages(slot)          # pages free NOW too
+        return finished
+
     def pool_metrics(self) -> Dict[str, float]:
         """Page-pool health (paged layout only; {} otherwise): total/free/
         in-use/cached/watermark page counts, alloc/free/denied churn, the
@@ -1428,6 +1750,19 @@ class ContinuousBatcher:
         if self._prefix is not None:
             out.update(self._prefix.metrics())
             out["prefill_tokens_skipped"] = float(self._skipped_tokens)
+        if self.spec:
+            # Speculation gauges: accept rate (proposals accepted /
+            # proposed — how often prompt-lookup pays), committed tokens
+            # per active slot per verify dispatch (the per-slot tok/s
+            # multiplier vs the 1.0 of plain decode), and the cumulative
+            # overshoot rows rewound by the lens clamp.
+            out["spec_accept_rate"] = (
+                self._spec_accepted / self._spec_proposed
+                if self._spec_proposed else 0.0)
+            out["spec_tokens_per_dispatch"] = (
+                self._spec_emitted / self._spec_slot_steps
+                if self._spec_slot_steps else 0.0)
+            out["spec_rewound_tokens_total"] = float(self._spec_rewound)
         return out
 
     def _flush(self) -> None:

@@ -38,9 +38,10 @@ from .layers import apply_rope, rms_norm, rope_freqs, swiglu  # noqa: E402
 from .attention import dense_attention, ring_attention, ulysses_attention  # noqa: E402
 from .flash_attention import flash_attention, flash_attention_diff  # noqa: E402
 from .decode_attention import (  # noqa: E402
-    DEFAULT_PAGE_SIZE, decode_plan, dense_decode_reference,
-    flash_decode_attention, gather_paged_kv, paged_decode_attention,
-    paged_plan,
+    DEFAULT_PAGE_SIZE, contiguous_as_paged, decode_plan,
+    dense_decode_reference, dense_verify_reference, flash_decode_attention,
+    gather_paged_kv, paged_decode_attention, paged_plan,
+    paged_verify_attention, verify_plan,
 )
 from .moe import load_balancing_loss, moe_ffn, moe_ffn_dropless  # noqa: E402
 from .quant import dequantize_weight, qdot, quantize_llama_params, quantize_weight  # noqa: E402
@@ -67,6 +68,10 @@ __all__ = [
     "paged_plan",
     "paged_decode_attention",
     "gather_paged_kv",
+    "verify_plan",
+    "paged_verify_attention",
+    "dense_verify_reference",
+    "contiguous_as_paged",
     "moe_ffn",
     "moe_ffn_dropless",
     "load_balancing_loss",

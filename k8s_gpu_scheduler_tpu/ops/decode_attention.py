@@ -53,6 +53,20 @@ block coordinates. Everything else carries over: O(pos) traffic via the
 traced length mask with clamped index maps, in-kernel GQA, int8-KV
 dequant in registers, split-K + LSE combine. `gather_paged_kv` is the
 indirection as a dense gather — the reference/fallback path.
+
+**Multi-query verify variant** (`paged_verify_attention`): the same paged
+kernel body with a q block of ``t = 1+gamma`` rows per slot — the verify
+window of speculative decoding (Leviathan et al. 2023; prompt-lookup
+proposals in models/serving.py). Window row i sits at absolute position
+``lengths[b] + i`` and attends the committed prefix plus the window
+causally: cols < ``lengths[b] + i + 1``, a PER-ROW length mask instead of
+the decode kernel's per-slot scalar. Everything else is unchanged —
+block-table indirection in the index maps, O(pos) traffic via clamping
+past ``lengths + t``, in-kernel GQA (the q block is the whole [t·g, hd]
+row stack, so one cache read feeds every window row of every head in the
+group), int8 dequant in registers, split-K + LSE combine.
+``dense_verify_reference`` is the grouped-einsum formulation of the same
+contract — numerical reference and automatic fallback.
 """
 from __future__ import annotations
 
@@ -128,6 +142,20 @@ def paged_plan(n_blocks: int, page_size: int,
     return n_splits
 
 
+def verify_plan(n_blocks: int, page_size: int, t: int,
+                n_splits: Optional[int] = None) -> Optional[int]:
+    """Legal split count for a multi-query verify window of ``t`` rows
+    over a paged cache, or None when not coverable. The kv side is
+    exactly ``paged_plan`` (the page is the kv block); the q side only
+    needs t >= 1 — the window rides as extra q rows, not extra grid, so
+    it never changes the blocking. VMEM headroom for large t·g row
+    stacks is the budgeter's contract (analysis/vmem.py
+    paged_verify_attention_footprint), not a plan gate."""
+    if t < 1:
+        return None
+    return paged_plan(n_blocks, page_size, n_splits)
+
+
 def gather_paged_kv(pages: jax.Array, block_table: jax.Array) -> jax.Array:
     """Materialize a sequence-contiguous view of a paged pool: pages
     [n_pages, page_size, ...] gathered through block_table [B, n_blocks]
@@ -189,6 +217,53 @@ def dense_decode_reference(q: jax.Array, k: jax.Array, v: jax.Array,
         vf = v
     out = jnp.einsum("bhgk,bkhd->bhgd", probs, vf)
     return out.reshape(b, n_heads, hd)
+
+
+def dense_verify_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                           lengths, k_scale=None, v_scale=None) -> jax.Array:
+    """Grouped-einsum multi-query verify attention: the t-row window
+    q [B, t, H, hd] against the cache [B, S, Hkv, hd] → [B, t, H, hd].
+
+    ``lengths`` (scalar or [B] int32) counts the COMMITTED rows — the
+    filled prefix BEFORE the window; the window's own K/V must already
+    sit at rows lengths..lengths+t-1 (the serving verify pass writes them
+    first). Window row i attends cols < lengths + i + 1: the committed
+    prefix plus itself and earlier window rows — causal inside the
+    window. GQA/int8 factoring matches ``dense_decode_reference``
+    (grouped head axis, per-row scales on scores/probs); at t == 1 this
+    is exactly ``dense_decode_reference`` with ``lengths + 1``."""
+    b, t, n_heads, hd = q.shape
+    s, h_kv = k.shape[1], k.shape[2]
+    if n_heads % h_kv:
+        raise ValueError(
+            f"GQA needs n_heads ({n_heads}) divisible by kv heads ({h_kv})")
+    g = n_heads // h_kv
+    quant = k_scale is not None
+    if quant and v_scale is None:
+        raise ValueError("int8-KV mode needs both k_scale and v_scale")
+    scale = 1.0 / math.sqrt(hd)
+    qg = q.reshape(b, t, h_kv, g, hd)
+    kf = k.astype(q.dtype) if quant else k
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, kf).astype(jnp.float32) * scale
+    if quant:
+        scores = scores * jnp.transpose(
+            k_scale[..., 0], (0, 2, 1))[:, :, None, None, :]
+    lengths = jnp.asarray(lengths, jnp.int32)
+    if lengths.ndim == 0:
+        lengths = jnp.full((b,), lengths, jnp.int32)
+    bound = lengths[:, None] + jnp.arange(t)[None, :] + 1      # [B, t]
+    mask = jnp.arange(s)[None, None, :] < bound[..., None]     # [B, t, S]
+    scores = jnp.where(mask[:, None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if quant:
+        probs = probs * jnp.transpose(
+            v_scale[..., 0], (0, 2, 1))[:, :, None, None, :].astype(q.dtype)
+        vf = v.astype(q.dtype)
+    else:
+        vf = v
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
+    return out.reshape(b, t, n_heads, hd)
 
 
 # -- kernel -------------------------------------------------------------------
@@ -513,3 +588,221 @@ def paged_decode_attention(
         interpret=interpret,
     )(lengths, block_table, *inputs)
     return _combine_splits(acc, m, l, b, n_heads, hd, q.dtype)
+
+
+# -- multi-query verify kernel ------------------------------------------------
+
+def _verify_kernel(lengths_ref, table_ref, q_ref, k_ref, v_ref, *rest,
+                   scale: float, block_k: int, n_kv: int, bps: int,
+                   quant: bool, t: int, g: int):
+    """Multi-query body: the q block is the whole [t·g, hd] row stack of
+    one slot's verify window for one kv head group (row i·g+j = window
+    token i, group head j). The only change from `_decode_kernel` is the
+    PER-ROW mask — window token i attends cols < base + i + 1 — and the
+    skip bound growing by t; the online-softmax math is row-independent
+    either way, so each window row accumulates exactly what the t = 1
+    kernel would at its own length bound."""
+    del table_ref                # consumed by the BlockSpec index maps only
+    if quant:
+        ks_ref, vs_ref, *rest = rest
+    o_ref, mo_ref, lo_ref, acc_ref, m_ref, l_ref = rest
+
+    bh = pl.program_id(0)
+    j = pl.program_id(2)
+    split = pl.program_id(1)
+    b = bh // n_kv
+    blk = split * bps + j                      # UNclamped LOGICAL kv block
+    base = lengths_ref[b]                      # committed rows pre-window
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Blocks entirely past the furthest row ANY window token may attend
+    # (base + t): compute skipped, DMA skipped by the clamped index maps.
+    @pl.when(blk * block_k < base + t)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)                   # [t*g, hd]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)          # [bk, hd]
+        if quant:
+            k = k * ks_ref[0, :, 0, :]                     # dequant in regs
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                          # [t*g, bk]
+        col = blk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (t * g, block_k), 1)
+        row_tok = jax.lax.broadcasted_iota(
+            jnp.int32, (t * g, block_k), 0) // g           # window token idx
+        mask = col < base + row_tok + 1                    # [t*g, bk]
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                              # [t*g, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # Explicit zero at masked columns: a row whose window hasn't
+        # reached this block yet leaves m_new at -inf and exp(s - m_new)
+        # == 1 everywhere without it.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)       # [t*g, bk]
+        alpha = jnp.exp(m_prev - m_new)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quant:
+            v = v * vs_ref[0, :, 0, :]
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[0, 0] = acc_ref[:]
+        mo_ref[0, 0] = m_ref[:]
+        lo_ref[0, 0] = l_ref[:]
+
+
+def paged_verify_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_table: jax.Array,
+    lengths,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    n_splits: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused multi-query verify attention over a PAGED KV cache: the
+    speculative verify window q [B, t, H, hd] (t = 1+gamma) against the
+    page pool k/v [n_pages, page_size, Hkv, hd] through ``block_table``
+    [B, n_blocks] — one batched dispatch verifies every slot's window.
+
+    ``lengths`` (scalar or [B] int32) counts the COMMITTED rows — the
+    filled logical prefix BEFORE the window. The window's own K/V must
+    already sit at logical rows lengths..lengths+t-1 of each slot (the
+    serving verify pass scatters them before attending, exactly like the
+    decode step writes its row first). Window row i attends cols <
+    lengths + i + 1 — committed prefix plus the window causally — via a
+    per-row mask inside the kernel; blocks past lengths + t are
+    compute-skipped with index maps clamped to the last valid block, so
+    traffic stays O(pos). Rows above each row's bound may be garbage
+    (rejected overshoot of a previous verify, stale pages) — they are
+    masked, never contributing. At t == 1 this is ``paged_decode_
+    attention`` with ``lengths + 1`` exactly (same body, scalar mask).
+
+    ``k_scale``/``v_scale`` [n_pages, page_size, Hkv, 1] switch to
+    int8-KV mode. Raises ValueError when ``verify_plan`` has no legal
+    covering — callers that want silent degradation check the plan first
+    and fall back to ``gather_paged_kv`` + ``dense_verify_reference``."""
+    b, t, n_heads, hd = q.shape
+    if k_pages.shape[3] != hd or v_pages.shape != k_pages.shape:
+        raise ValueError(f"page pool shape {k_pages.shape}/{v_pages.shape} "
+                         f"does not match q {q.shape}")
+    if block_table.ndim != 2 or block_table.shape[0] != b:
+        raise ValueError(f"block_table must be [B={b}, n_blocks], got "
+                         f"{block_table.shape}")
+    ps, n_kv = k_pages.shape[1], k_pages.shape[2]
+    n_blocks = block_table.shape[1]
+    if n_heads % n_kv:
+        raise ValueError(
+            f"GQA needs n_heads ({n_heads}) divisible by kv heads ({n_kv})")
+    g = n_heads // n_kv
+    n_splits = verify_plan(n_blocks, ps, t, n_splits)
+    if n_splits is None:
+        raise ValueError(f"no legal verify blocking for n_blocks={n_blocks},"
+                         f" page_size={ps}, t={t}")
+    bps = n_blocks // n_splits
+    quant = k_scale is not None
+    if quant and v_scale is None:
+        raise ValueError("int8-KV mode needs both k_scale and v_scale")
+    from . import pallas_interpret
+    interpret = pallas_interpret(interpret)
+
+    lengths = jnp.asarray(lengths, jnp.int32)
+    if lengths.ndim == 0:
+        lengths = jnp.full((b,), lengths, jnp.int32)
+    block_table = jnp.asarray(block_table, jnp.int32)
+    # [B, t, H, hd] → [B·Hkv, t·g, hd]: fold (B, Hkv) into the grid axis
+    # and stack the window rows of one head GROUP — each streamed cache
+    # row feeds all t·g q rows through one MXU contraction.
+    q4 = q.reshape(b, t, n_kv, g, hd).transpose(0, 2, 1, 3, 4) \
+        .reshape(b * n_kv, t * g, hd)
+
+    def kv_map(bh, split, j, lens, table):
+        bb = bh // n_kv
+        blk = split * bps + j                        # LOGICAL kv block
+        # The furthest attendable row is lens + t - 1 (the window's own
+        # last row), so clamp past ceil((lens + t)/ps) — the verify-window
+        # analog of the decode map's lens bound.
+        last = jnp.maximum(
+            jax.lax.div(lens[bb] + t + ps - 1, ps) - 1, 0)
+        return (table[bb, jnp.minimum(blk, last)], 0, bh % n_kv, 0)
+
+    kv_spec = pl.BlockSpec((1, ps, 1, hd), kv_map)
+    in_specs = [
+        pl.BlockSpec((1, t * g, hd),
+                     lambda bh, split, j, lens, table: (bh, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    inputs = [q4, k_pages, v_pages]
+    if quant:
+        sc_spec = pl.BlockSpec((1, ps, 1, 1), kv_map)
+        in_specs += [sc_spec, sc_spec]
+        inputs += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+
+    part_spec = lambda lanes: pl.BlockSpec(                      # noqa: E731
+        (1, 1, t * g, lanes),
+        lambda bh, split, j, lens, table: (bh, split, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * n_kv, n_splits, bps),
+        in_specs=in_specs,
+        out_specs=[part_spec(hd), part_spec(_LANES), part_spec(_LANES)],
+        scratch_shapes=[
+            pltpu.VMEM((t * g, hd), jnp.float32),     # acc
+            pltpu.VMEM((t * g, _LANES), jnp.float32),  # m
+            pltpu.VMEM((t * g, _LANES), jnp.float32),  # l
+        ],
+    )
+    kernel = functools.partial(
+        _verify_kernel, scale=1.0 / math.sqrt(hd), block_k=ps,
+        n_kv=n_kv, bps=bps, quant=quant, t=t, g=g)
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n_kv, n_splits, t * g, hd),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((b * n_kv, n_splits, t * g, _LANES),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((b * n_kv, n_splits, t * g, _LANES),
+                                 jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(lengths, block_table, *inputs)
+    # _combine_splits' "head" axis is just the per-program row count; undo
+    # the (Hkv, t, g) fold back to window-major [B, t, H, hd].
+    out = _combine_splits(acc, m, l, b, n_kv * t * g, hd, q.dtype)
+    return out.reshape(b, n_kv, t, g, hd).transpose(0, 2, 1, 3, 4) \
+        .reshape(b, t, n_heads, hd)
+
+
+def contiguous_as_paged(cache: jax.Array, block_k: int):
+    """View a contiguous cache [B, S, ...] as a page pool + block table
+    with NO data movement the compiler can't elide: block j of batch b is
+    \"page\" b·(S/block_k)+j, so the pool is just the cache reshaped and
+    the table is an iota. Lets the multi-query verify kernel serve the
+    CONTIGUOUS serving path (generate_speculative's 1+gamma window)
+    without a second kernel body."""
+    b, s = cache.shape[:2]
+    nb = s // block_k
+    pool = cache.reshape(b * nb, block_k, *cache.shape[2:])
+    table = (jnp.arange(b, dtype=jnp.int32)[:, None] * nb
+             + jnp.arange(nb, dtype=jnp.int32)[None, :])
+    return pool, table

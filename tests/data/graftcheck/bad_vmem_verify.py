@@ -1,0 +1,22 @@
+"""Seeded-bad fixture: a multi-query verify footprint over the VMEM
+budget.
+
+Same ``GRAFTCHECK_VMEM_AUDIT`` hook protocol as bad_vmem.py /
+bad_vmem_paged.py, speculative-verify edition: the page blocks here are
+MODEST (256-row int8 pages — nothing the decode budgeter would flag),
+but a 64-row verify window over a 32-head GQA group at hd=512 stacks
+t·g = 2048 q rows, so the q block + three partial outputs + (acc, m, l)
+scratch alone blow past the 16 MiB core — the "just raise gamma" tuning
+mistake the verify footprint's q-window multiplier exists to catch
+before Mosaic does, in production, at the first speculative config.
+"""
+from k8s_gpu_scheduler_tpu.analysis.vmem import (
+    paged_verify_attention_footprint,
+)
+
+GRAFTCHECK_VMEM_AUDIT = [
+    ("oversized_verify_window",
+     paged_verify_attention_footprint(page_size=256, g=32, hd=512,
+                                      n_blocks=32, t=64, batch=32,
+                                      quant=True)),
+]

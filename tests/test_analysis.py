@@ -199,6 +199,39 @@ class TestVmem:
         findings = bad.check()
         assert len(findings) == 1 and findings[0].rule == "vmem-budget"
 
+    def test_verify_footprint_window_multiplier(self):
+        """The multi-query verify footprint: serving shapes fit at
+        realistic gammas, and the t·g q-window multiplier alone walks a
+        modest-page config over the budget — the failure mode the decode
+        footprint cannot see."""
+        from k8s_gpu_scheduler_tpu.analysis import (
+            paged_verify_attention_footprint,
+        )
+
+        ok = paged_verify_attention_footprint(64, 4, 128, 128, t=5,
+                                              quant=True)
+        assert ok.check() == []
+        # Same kv-side shape as the passing paged decode footprint at
+        # page 256 — only the window grows.
+        small = paged_verify_attention_footprint(256, 32, 512, 32, t=1,
+                                                 batch=32, quant=True)
+        assert small.check() == []
+        big = paged_verify_attention_footprint(256, 32, 512, 32, t=64,
+                                               batch=32, quant=True)
+        findings = big.check()
+        assert len(findings) == 1 and findings[0].rule == "vmem-budget"
+        assert "q-window rows" in findings[0].message
+
+    def test_bad_vmem_verify_fixture_is_over_budget(self):
+        sys.path.insert(0, FIXTURES)
+        try:
+            import bad_vmem_verify
+        finally:
+            sys.path.pop(0)
+        (name, fp), = bad_vmem_verify.GRAFTCHECK_VMEM_AUDIT
+        assert name == "oversized_verify_window"
+        assert rules_of(fp.check()) == {"vmem-budget"}
+
     def test_paged_page_size_divisibility_finding(self, monkeypatch):
         """A preset cache length the default page size does not divide
         must surface as block-divisibility from audit_vmem's PAGED arm —
@@ -427,6 +460,70 @@ class TestBatcherSteadyState:
         assert recompile_guard.misses_since() == {"decode": 0, "prefill": 0}
         eng.run()                                  # drain the long request
 
+    def test_spec_three_waves_varying_accepts_zero_retrace(
+            self, recompile_guard):
+        """Speculative edition: three waves whose verify dispatches
+        commit DIFFERENT numbers of tokens (repetitive prompts accept,
+        random prompts reject everything) must be zero-retrace — the
+        window pads to the fixed 1+gamma and the commit length is
+        traced — with the pool AND the block table still riding the
+        donation chain on every verify dispatch."""
+        import dataclasses
+
+        import jax
+
+        from k8s_gpu_scheduler_tpu.models.llama import (
+            LlamaConfig, init_params,
+        )
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), decode_attn="fused")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                                chunk=2, prefill_bucket=8, kv_dtype="int8",
+                                kv_layout="paged", page_size=8,
+                                speculative=True, gamma=2)
+        rng = np.random.default_rng(0)
+        phrase = list(rng.integers(0, cfg.vocab, 3))
+        # Warmup: prefill rung + the verify program under both block-
+        # table jit keys (numpy upload on admission steps, committed
+        # device table on pure-verify steps).
+        eng.submit(phrase * 2, max_new=6)
+        eng.run()
+
+        recompile_guard.track("decode", eng._decode)
+        recompile_guard.track("prefill", eng._prefill)
+        recompile_guard.snapshot()
+        for _ in range(3):
+            # One cycling prompt (multi-token accepts once the stream
+            # loops), one random prompt (0-accept rewinds): the waves'
+            # verify dispatches commit anywhere from 1 to gamma+1 tokens.
+            eng.submit(phrase * 2, max_new=16)
+            eng.submit(list(rng.integers(0, cfg.vocab, 5)), max_new=4)
+            k_before = eng._k
+            while eng.pending:
+                eng.step()
+            # Donation held for the pool on every verify dispatch (the
+            # wave's first included).
+            assert k_before.is_deleted(), "kv page pool was not donated"
+        m = eng.pool_metrics()
+        assert m["spec_accept_rate"] > 0, "waves must actually accept"
+        assert m["spec_rewound_tokens_total"] > 0, \
+            "waves must actually rewind"
+        # Pure verify steps (no admission/free): the device-resident
+        # table must be donated-through — consumed, not copied.
+        eng.submit(list(rng.integers(0, cfg.vocab, 5)), max_new=8)
+        eng.step()                                 # admission step
+        k_before, tbl_before = eng._k, eng._table
+        assert hasattr(tbl_before, "is_deleted"), "table should be on device"
+        eng.step()                                 # pure verify step
+        assert k_before.is_deleted(), "kv page pool was not donated"
+        assert tbl_before.is_deleted(), "block table was not donated"
+        assert recompile_guard.misses_since() == {"decode": 0,
+                                                  "prefill": 0}
+        eng.run()                                  # drain
+        eng._alloc.assert_consistent()
+
 
 # -- shared-page (alias) audit ------------------------------------------------
 
@@ -540,11 +637,14 @@ class TestCli:
 
     def test_reintroduced_fast_fixtures_fail(self):
         for fixture in ("bad_astlint.py", "bad_vmem.py",
-                        "bad_vmem_paged.py"):
+                        "bad_vmem_paged.py", "bad_vmem_verify.py"):
             proc = run_cli(os.path.join(FIXTURES, fixture))
             assert proc.returncode == 1, (fixture, proc.stderr)
             assert ": [" in proc.stderr       # file:line: [rule] rendering
 
+    @pytest.mark.slow   # ~1 min of traced-pass subprocess; the fast-pass
+    # fixture test above keeps per-family CLI signal in tier-1, and the
+    # unfiltered CI suite runs this end-to-end check.
     def test_full_cli_catches_all_five_fixture_families(self):
         """The acceptance criterion end-to-end: the DEFAULT five-pass CLI
         exits non-zero with file:line findings when the seeded bad

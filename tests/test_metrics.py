@@ -254,10 +254,32 @@ class TestServingPoolExport:
         export_serving_pool(reg, {"pages_free": 3.0})
         assert "tpu_serve_pages_free 3.0" in reg.expose()
 
-    def test_live_engine_snapshot_exports(self):
-        """End to end against a real paged engine with the prefix cache:
-        pool_metrics() -> gauges, including the reuse counters."""
-        import dataclasses
+    def test_spec_gauges_exported(self):
+        """The speculation gauges ride the same map: a snapshot with the
+        spec_* keys (paged engine, speculative=True) round-trips through
+        /metrics with help text."""
+        from k8s_gpu_scheduler_tpu.metrics import (
+            SERVING_POOL_GAUGES, export_serving_pool,
+        )
+
+        reg = Registry()
+        snapshot = {
+            "spec_accept_rate": 0.42,
+            "spec_tokens_per_dispatch": 2.25,
+            "spec_rewound_tokens_total": 96.0,
+        }
+        export_serving_pool(reg, snapshot)
+        text = reg.expose()
+        assert "tpu_serve_spec_accept_rate 0.42" in text
+        assert "tpu_serve_spec_tokens_per_dispatch 2.25" in text
+        assert "tpu_serve_spec_rewound_tokens_total 96.0" in text
+        assert "# HELP tpu_serve_spec_accept_rate" in text
+        assert set(snapshot) <= set(SERVING_POOL_GAUGES)
+
+    def test_live_spec_engine_snapshot_exports(self):
+        """End to end against a real speculative paged engine: after a
+        drained wave, pool_metrics() carries the spec gauges and the
+        exporter publishes them."""
 
         import jax
         import numpy as np
@@ -266,7 +288,37 @@ class TestServingPoolExport:
         from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
         from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
 
-        cfg = dataclasses.replace(LlamaConfig.tiny())
+        cfg = LlamaConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=32,
+                                chunk=2, prefill_bucket=8,
+                                kv_layout="paged", page_size=8,
+                                speculative=True, gamma=2)
+        eng.submit(list(rng.integers(0, cfg.vocab, 5)), max_new=4)
+        eng.run()
+        reg = Registry()
+        export_serving_pool(reg, eng.pool_metrics())
+        text = reg.expose()
+        assert "tpu_serve_spec_accept_rate" in text
+        assert "tpu_serve_spec_tokens_per_dispatch" in text
+        # 3 verify steps after the prefill token, gamma=2 each: the
+        # rewound total is (gamma - accepted) summed — present and
+        # consistent with the accept counters either way.
+        assert "tpu_serve_spec_rewound_tokens_total" in text
+
+    def test_live_engine_snapshot_exports(self):
+        """End to end against a real paged engine with the prefix cache:
+        pool_metrics() -> gauges, including the reuse counters."""
+
+        import jax
+        import numpy as np
+
+        from k8s_gpu_scheduler_tpu.metrics import export_serving_pool
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = LlamaConfig.tiny()
         params = init_params(cfg, jax.random.PRNGKey(0))
         rng = np.random.default_rng(0)
         eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=32,

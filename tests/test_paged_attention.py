@@ -223,8 +223,12 @@ class TestPagedEngine:
         done = eng.run()
         return [done[i] for i in ids], eng
 
-    @pytest.mark.parametrize("kvd", [None, "int8"])
-    @pytest.mark.parametrize("impl", ["dense", "fused"])
+    @pytest.mark.parametrize("impl,kvd", [
+        ("dense", None),
+        pytest.param("dense", "int8", marks=pytest.mark.slow),
+        pytest.param("fused", None, marks=pytest.mark.slow),
+        ("fused", "int8"),
+    ])
     def test_paged_matches_contiguous_engine(self, impl, kvd):
         cfg = self._cfg(decode_attn=impl)
         rng = np.random.default_rng(0)
@@ -446,6 +450,7 @@ class TestPageAllocator:
 
 
 class TestBenchLeg:
+    @pytest.mark.slow   # the dedicated CI step runs the same leg
     def test_paged_attention_microbench_smoke(self):
         """`bench.py --leg paged_attention --smoke` must emit ONE JSON
         line with paged-vs-contiguous fused-vs-dense tokens/s for both

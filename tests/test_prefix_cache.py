@@ -198,8 +198,12 @@ class TestPrefixEngineParity:
         done = eng.run()
         return [done[i] for i in ids], eng
 
-    @pytest.mark.parametrize("kvd", [None, "int8"])
-    @pytest.mark.parametrize("impl", ["dense", "fused"])
+    @pytest.mark.parametrize("impl,kvd", [
+        ("dense", None),
+        pytest.param("dense", "int8", marks=pytest.mark.slow),
+        pytest.param("fused", None, marks=pytest.mark.slow),
+        ("fused", "int8"),
+    ])
     def test_cache_on_matches_cache_off(self, impl, kvd):
         """The acceptance grid: shared-prefix batches are token-identical
         with the cache on and off, dense and fused, both cache dtypes —
@@ -322,6 +326,7 @@ class TestPrefixEngineBehavior:
 
 
 class TestBenchLeg:
+    @pytest.mark.slow   # the dedicated CI step runs the same leg
     def test_prefix_cache_bench_smoke(self):
         """`bench.py --leg prefix_cache --smoke` must emit ONE JSON line
         whose reuse contract holds: prefill tokens skipped > 0 and a

@@ -1,0 +1,465 @@
+"""Speculative decoding inside the paged continuous batcher — the
+multi-query verify kernel (ops/decode_attention.paged_verify_attention)
+and the batcher's propose/verify/accept loop (serving.ContinuousBatcher
+speculative=True).
+
+Two layers of parity:
+
+- **Kernel**: the verify window's per-row causal bound must reproduce the
+  dense multi-query reference AND, row by row, the t = 1 decode kernel at
+  that row's own length — the property that makes the speculative stream
+  equal the greedy stream (each window row accumulates exactly what its
+  own decode step would).
+- **Engine**: `speculative=True` must emit BYTE-IDENTICAL token streams
+  to plain greedy paged decode across dense/fused verify × f32/bf16 ×
+  int8-KV × prefix-cache on/off — including steps where every proposal
+  is rejected (0-accept full rewinds). Rewind is a lens clamp inside the
+  slot's own reserved pages: the allocator invariant must hold through
+  exhaustion/EOS/reject-all waves, and mounted shared prefix pages must
+  come back byte-identical (the graftcheck alias scenario's contract,
+  re-checked here at the engine level).
+
+Everything runs in interpret mode on CPU (ops.pallas_interpret); the
+same kernel compiles on TPU, where `bench.py --leg speculative` measures
+the accept-rate/tok-s story.
+"""
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_scheduler_tpu.ops import (
+    contiguous_as_paged, dense_verify_reference, paged_decode_attention,
+    paged_verify_attention, verify_plan,
+)
+
+TOL = {jnp.float32: 3e-6, jnp.bfloat16: 4e-2}
+
+
+def maxdiff(a, b):
+    return float(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)).max())
+
+
+def verify_case(B=2, H=8, Hkv=4, hd=32, S=64, ps=16, t=4,
+                dtype=jnp.float32, seed=0, perm_seed=0):
+    """A t-row verify window plus a contiguous cache and its paged twin
+    (pages scattered through a random permutation, page 0 null)."""
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, t, H, hd), dtype)
+    k = jax.random.normal(ks[1], (B, S, Hkv, hd), dtype)
+    v = jax.random.normal(ks[2], (B, S, Hkv, hd), dtype)
+    nb = S // ps
+    n_pages = 1 + B * nb
+    rng = np.random.default_rng(perm_seed)
+    table = rng.permutation(np.arange(1, n_pages)).reshape(B, nb)
+    kp = jnp.zeros((n_pages, ps, Hkv, hd), dtype)
+    vp = jnp.zeros((n_pages, ps, Hkv, hd), dtype)
+    kp = kp.at[table].set(k.reshape(B, nb, ps, Hkv, hd))
+    vp = vp.at[table].set(v.reshape(B, nb, ps, Hkv, hd))
+    return q, k, v, kp, vp, jnp.asarray(table, jnp.int32)
+
+
+class TestVerifyPlan:
+    def test_plan_legality(self):
+        assert verify_plan(4, 16, 4) == 1
+        assert verify_plan(8, 16, 3) == 8          # splits engage at >= 8
+        assert verify_plan(8, 16, 3, n_splits=2) == 2
+        assert verify_plan(8, 16, 0) is None       # empty window
+        assert verify_plan(8, 12, 3) is None       # non-pow2 page
+        assert verify_plan(8, 16, 3, n_splits=3) is None
+
+
+class TestVerifyKernelParity:
+    """paged_verify_attention against the dense multi-query reference and
+    the t = 1 decode kernel."""
+
+    # f32 cells pin the math per GQA ratio; bf16 re-runs (same code path,
+    # looser tolerance) ride the unfiltered CI suite only.
+    @pytest.mark.parametrize("dtype,hkv", [
+        (jnp.float32, 8), (jnp.float32, 4), (jnp.float32, 2),
+        pytest.param(jnp.bfloat16, 8, marks=pytest.mark.slow),
+        pytest.param(jnp.bfloat16, 4, marks=pytest.mark.slow),
+        pytest.param(jnp.bfloat16, 2, marks=pytest.mark.slow),
+    ])
+    def test_gqa_and_dtypes(self, dtype, hkv):
+        q, k, v, kp, vp, table = verify_case(Hkv=hkv, dtype=dtype)
+        lens = jnp.asarray([17, 33], jnp.int32)
+        ref = dense_verify_reference(q, k, v, lens)
+        got = paged_verify_attention(q, kp, vp, table, lens)
+        assert got.shape == q.shape
+        assert maxdiff(got, ref) < TOL[dtype]
+
+    def test_rows_match_the_decode_kernel(self):
+        """THE speculative-correctness property: window row i must equal
+        the t = 1 paged decode kernel at lengths + i + 1 — what that
+        token's own greedy decode step would have computed."""
+        q, k, v, kp, vp, table = verify_case(t=4)
+        lens = jnp.asarray([9, 30], jnp.int32)
+        got = paged_verify_attention(q, kp, vp, table, lens)
+        for i in range(q.shape[1]):
+            one = paged_decode_attention(q[:, i], kp, vp, table,
+                                         lens + i + 1)
+            assert maxdiff(got[:, i], one) < 1e-6, i
+
+    def test_t1_is_the_decode_kernel(self):
+        q, k, v, kp, vp, table = verify_case(t=1)
+        lens = jnp.asarray([11, 25], jnp.int32)
+        got = paged_verify_attention(q, kp, vp, table, lens)
+        one = paged_decode_attention(q[:, 0], kp, vp, table, lens + 1)
+        assert maxdiff(got[:, 0], one) < 1e-6
+
+    def test_int8_kv(self):
+        from k8s_gpu_scheduler_tpu.models.serving import _kv_quant
+
+        q, k, v, kp, vp, table = verify_case(t=3, dtype=jnp.bfloat16)
+        k8, ks = _kv_quant(k)
+        v8, vs = _kv_quant(v)
+        nb = k.shape[1] // kp.shape[1]
+        B, ps = q.shape[0], kp.shape[1]
+
+        def pool_of(a):
+            out = jnp.zeros((kp.shape[0], ps) + a.shape[2:], a.dtype)
+            return out.at[table].set(a.reshape(B, nb, ps, *a.shape[2:]))
+
+        lens = jnp.asarray([9, 30], jnp.int32)
+        ref = dense_verify_reference(q, k8, v8, lens, k_scale=ks,
+                                     v_scale=vs)
+        got = paged_verify_attention(q, pool_of(k8), pool_of(v8), table,
+                                     lens, k_scale=pool_of(ks),
+                                     v_scale=pool_of(vs))
+        assert maxdiff(got, ref) < TOL[jnp.bfloat16]
+
+    def test_split_k(self):
+        q, k, v, kp, vp, table = verify_case(S=128, ps=16, t=3)
+        lens = jnp.asarray([77, 121], jnp.int32)
+        ref = dense_verify_reference(q, k, v, lens)
+        for ns in (1, 8):                    # no-split vs max-split ends
+            got = paged_verify_attention(q, kp, vp, table, lens,
+                                         n_splits=ns)
+            assert maxdiff(got, ref) < 1e-5, ns
+
+    def test_stale_overshoot_rows_are_masked(self):
+        """Garbage above each row's bound — exactly what rejected
+        overshoot leaves behind — must never contribute."""
+        q, k, v, kp, vp, table = verify_case(t=3)
+        lens = jnp.asarray([10, 20], jnp.int32)
+        ref = paged_verify_attention(q, kp, vp, table, lens)
+        # Poison every row past lens + t (committed + window).
+        S, ps = k.shape[1], kp.shape[1]
+        col = np.arange(S)
+        poison = np.zeros((2, S), bool)
+        for b in range(2):
+            poison[b] = col >= int(lens[b]) + q.shape[1]
+        nb = S // ps
+        pb = jnp.asarray(poison).reshape(2, nb, ps)
+        kp2 = kp.at[table].set(
+            jnp.where(pb[..., None, None], 1e4,
+                      kp[table].reshape(2, nb, ps, *kp.shape[2:])))
+        vp2 = vp.at[table].set(
+            jnp.where(pb[..., None, None], -1e4,
+                      vp[table].reshape(2, nb, ps, *vp.shape[2:])))
+        got = paged_verify_attention(q, kp2, vp2, table, lens)
+        assert maxdiff(got, ref) < 1e-6
+
+    def test_contiguous_view_and_cached_attention(self):
+        """contiguous_as_paged + the kernel == cached_attention's dense
+        t > 1 mask — the generate_speculative fused verify route."""
+        from k8s_gpu_scheduler_tpu.models.serving import cached_attention
+
+        q, k, v, _, _, _ = verify_case(t=3)
+        pos = jnp.int32(21)
+        ref = cached_attention(q, k, v, pos, impl="dense")
+        kp, table = contiguous_as_paged(k, 16)
+        vp, _ = contiguous_as_paged(v, 16)
+        got = paged_verify_attention(q, kp, vp, table, pos)
+        assert maxdiff(got, ref) < 1e-5
+        # And the routed call itself takes the kernel path.
+        routed = cached_attention(q, k, v, pos, impl="fused", verify=True)
+        assert maxdiff(routed, ref) < 1e-5
+
+    def test_bad_shapes_raise(self):
+        q, k, v, kp, vp, table = verify_case(t=0 + 2)
+        with pytest.raises(ValueError, match="GQA"):
+            paged_verify_attention(q[:, :, :6], kp, vp, table, 4)
+        with pytest.raises(ValueError, match="block_table"):
+            paged_verify_attention(q, kp, vp, table[0], 4)
+        with pytest.raises(ValueError, match="verify blocking"):
+            paged_verify_attention(q[:, :0], kp, vp, table, 4)
+
+
+class TestSpeculativeEngine:
+    """speculative=True vs plain greedy paged decode: byte-identical
+    streams, free rewind, clean page accounting."""
+
+    def _cfg(self, dtype=jnp.float32, **kw):
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig
+
+        return dataclasses.replace(LlamaConfig.tiny(), dtype=dtype, **kw)
+
+    def _prompts(self, cfg, seed=0):
+        rng = np.random.default_rng(seed)
+        phrase = list(rng.integers(0, cfg.vocab, 4))
+        # A cycling prompt (accepts fire once the greedy stream loops),
+        # a random prompt (proposals mostly rejected), and a short
+        # phrase copy exercising slot reuse — all within ONE prefill
+        # bucket rung, so each engine compiles a single prefill program.
+        return [phrase * 2, list(rng.integers(0, cfg.vocab, 7)),
+                phrase + phrase[:1]]
+
+    def _run(self, cfg, prompts, spec, max_new=8, gamma=3, **kw):
+        from k8s_gpu_scheduler_tpu.models import init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                                chunk=4, prefill_bucket=8,
+                                kv_layout="paged", page_size=8,
+                                speculative=spec, gamma=gamma, **kw)
+        ids = [eng.submit(p, max_new=max_new) for p in prompts]
+        done = eng.run()
+        return [done[i] for i in ids], eng
+
+    # f32 grid: the all-reference (dense, bf16-free pool) and the
+    # all-production (fused, int8) corners stay in tier-1; the mixed
+    # cells ride the unfiltered CI suite (budget note on the bf16 grid).
+    @pytest.mark.parametrize("impl,kvd", [
+        ("dense", None),
+        pytest.param("dense", "int8", marks=pytest.mark.slow),
+        pytest.param("fused", None, marks=pytest.mark.slow),
+        ("fused", "int8"),
+    ])
+    def test_spec_matches_greedy_paged_f32(self, impl, kvd):
+        cfg = self._cfg(decode_attn=impl)
+        prompts = self._prompts(cfg)
+        spec, eng = self._run(cfg, prompts, True, kv_dtype=kvd)
+        plain, _ = self._run(cfg, prompts, False, kv_dtype=kvd)
+        assert spec == plain
+        m = eng.pool_metrics()
+        # Every page back at drain; the allocator invariant holds.
+        assert m["pages_in_use"] == 0 and m["pages_free"] == m["pages_total"]
+        eng._alloc.assert_consistent()
+
+    # bf16 grid: the fused+int8 cell (the production combination) stays
+    # in tier-1; the remaining bf16 cells ride the full CI suite only
+    # (tier-1 runs under a wall-clock budget with -m 'not slow').
+    @pytest.mark.parametrize("impl,kvd", [
+        pytest.param("dense", None, marks=pytest.mark.slow),
+        pytest.param("dense", "int8", marks=pytest.mark.slow),
+        pytest.param("fused", None, marks=pytest.mark.slow),
+        ("fused", "int8"),
+    ])
+    def test_spec_matches_greedy_paged_bf16(self, impl, kvd):
+        cfg = self._cfg(dtype=jnp.bfloat16, decode_attn=impl)
+        prompts = self._prompts(cfg)
+        spec, _ = self._run(cfg, prompts, True, kv_dtype=kvd)
+        plain, _ = self._run(cfg, prompts, False, kv_dtype=kvd)
+        assert spec == plain
+
+    @pytest.mark.parametrize("impl", [
+        pytest.param("dense", marks=pytest.mark.slow), "fused"])
+    def test_spec_matches_greedy_with_prefix_cache(self, impl):
+        """Speculation × shared-prefix reuse: hit admissions mount shared
+        pages read-only, the verify overshoot lands past them, and the
+        streams still match plain greedy paged decode with the same
+        cache."""
+        cfg = self._cfg(decode_attn=impl)
+        rng = np.random.default_rng(1)
+        sysp = list(rng.integers(0, cfg.vocab, 8))
+        prompts = [sysp + list(rng.integers(0, cfg.vocab, 3)),
+                   sysp + list(rng.integers(0, cfg.vocab, 4)),
+                   sysp + list(rng.integers(0, cfg.vocab, 2))]
+        spec, eng = self._run(cfg, prompts, True, kv_dtype="int8",
+                              prefix_cache=True)
+        plain, _ = self._run(cfg, prompts, False, kv_dtype="int8",
+                             prefix_cache=True)
+        assert spec == plain
+        m = eng.pool_metrics()
+        assert m["prefix_hit_tokens"] > 0, "scenario must actually hit"
+        eng._alloc.assert_consistent()
+
+    def test_speculation_actually_accepts(self):
+        """On a long self-repetitive stream the verify must commit more
+        than one token per dispatch — the whole point of the PR."""
+        cfg = self._cfg(decode_attn="fused")
+        rng = np.random.default_rng(0)
+        phrase = list(rng.integers(0, cfg.vocab, 4))
+        prompts = [phrase * 2, phrase + phrase[:1]]
+        spec, eng = self._run(cfg, prompts, True, max_new=28)
+        plain, _ = self._run(cfg, prompts, False, max_new=28)
+        assert spec == plain
+        m = eng.pool_metrics()
+        assert m["spec_accept_rate"] > 0
+        assert m["spec_tokens_per_dispatch"] > 1.0
+
+    def test_zero_accept_full_rewinds(self):
+        """A stream with no usable bigram repeats rejects every proposal:
+        one token per dispatch, gamma rows rewound per slot-step, output
+        still byte-identical."""
+        cfg = self._cfg(decode_attn="fused")
+        rng = np.random.default_rng(2)
+        prompts = [list(rng.integers(0, cfg.vocab, 5))]
+        spec, eng = self._run(cfg, prompts, True, max_new=5, gamma=3)
+        plain, _ = self._run(cfg, prompts, False, max_new=5, gamma=3)
+        assert spec == plain
+        m = eng.pool_metrics()
+        assert m["spec_accept_rate"] == 0.0, \
+            "prompt drew a usable bigram repeat — reseed to restore the " \
+            "zero-accept regime this test exists to cover"
+        assert m["spec_tokens_per_dispatch"] == 1.0
+        assert m["spec_rewound_tokens_total"] == 3 * 4  # gamma × steps
+        eng._alloc.assert_consistent()
+
+    def test_eos_reap_and_exhaustion_keep_pool_consistent(self):
+        """Rewind never leaks or double-frees a page: a tight pool under
+        page-exhaustion blocking, EOS early reaps mid-speculation, and
+        reject-heavy random streams must leave the allocator partitioned
+        clean after every step and fully free at drain."""
+        from k8s_gpu_scheduler_tpu.models import init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = self._cfg(decode_attn="fused")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(3)
+        # Three slots over a pool that can only back two live requests:
+        # the third admission finds a FREE SLOT but no pages — the
+        # page-denied path — until a finish returns its reservation.
+        eng = ContinuousBatcher(params, cfg, n_slots=3, max_len=64,
+                                chunk=4, prefill_bucket=8,
+                                kv_layout="paged", page_size=8,
+                                n_pages=7, speculative=True, gamma=3,
+                                eos_id=7)
+        for plen, mn in ((5, 9), (11, 5), (3, 13), (7, 3)):
+            eng.submit(rng.integers(0, cfg.vocab, plen), max_new=mn)
+        denied_seen = False
+        while eng.pending:
+            eng.step()
+            eng._alloc.assert_consistent()
+            denied_seen = denied_seen or \
+                eng.pool_metrics()["page_denied"] > 0
+        m = eng.pool_metrics()
+        assert m["pages_in_use"] == 0 and m["pages_free"] == m["pages_total"]
+        assert denied_seen, "pool was never exhausted; shrink n_pages"
+
+    @pytest.mark.slow   # tier-1 covers this via the graftcheck alias
+    def test_shared_prefix_pages_survive_overshoot(self):
+        """Engine-level alias check (the graftcheck scenario
+        `batcher_verify_paged_prefix` pins the same contract in tier-1
+        through tests/test_analysis.py): the bytes of a mounted shared
+        page are identical before and after speculative steps that verify
+        (and rewind) on top of it."""
+        from k8s_gpu_scheduler_tpu.models import init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = self._cfg(decode_attn="fused")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(4)
+        sysp = list(rng.integers(0, cfg.vocab, 8))
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                                chunk=4, prefill_bucket=8, kv_dtype="int8",
+                                kv_layout="paged", page_size=8,
+                                prefix_cache=True, speculative=True,
+                                gamma=3)
+        eng.submit(sysp + list(rng.integers(0, cfg.vocab, 3)), max_new=2)
+        eng.run()                          # reap donates the prefix page
+        eng.submit(sysp + list(rng.integers(0, cfg.vocab, 4)), max_new=9)
+        eng.step()                         # mounts the shared page
+        shared = sorted({p for pages in eng._slot_shared.values()
+                         for p in pages})
+        assert shared
+        before = np.array(np.asarray(eng._k)[:, shared])
+        before_s = np.array(np.asarray(eng._ks)[:, shared])
+        while eng.pending:
+            eng.step()
+        assert np.array_equal(np.asarray(eng._k)[:, shared], before)
+        assert np.array_equal(np.asarray(eng._ks)[:, shared], before_s)
+        eng._alloc.assert_consistent()
+
+    def test_rejects_bad_configs(self):
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = LlamaConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatcher(params, cfg, speculative=True)
+        with pytest.raises(ValueError, match="greedy"):
+            ContinuousBatcher(params, cfg, kv_layout="paged",
+                              max_len=64, speculative=True,
+                              temperature=0.7)
+        with pytest.raises(ValueError, match="gamma"):
+            ContinuousBatcher(params, cfg, kv_layout="paged",
+                              max_len=64, speculative=True, gamma=0)
+
+    def test_overshoot_reserved_in_admission_math(self):
+        """submit() must account the gamma overshoot: a request that fits
+        without speculation is rejected when the verify window would walk
+        past the cache capacity."""
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = LlamaConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        eng = ContinuousBatcher(params, cfg, kv_layout="paged",
+                                max_len=32, page_size=8, n_slots=2,
+                                speculative=True, gamma=4)
+        eng.submit(list(range(8)), max_new=21)       # 8 + 20 + 4 == 32
+        with pytest.raises(ValueError, match="exceeds"):
+            eng.submit(list(range(8)), max_new=22)   # ... == 33 > 32
+
+
+class TestGenerateSpeculativeFusedVerify:
+    """The B=1 reference API routed through the multi-query kernel."""
+
+    def test_fused_verify_token_identity(self):
+        from k8s_gpu_scheduler_tpu.models import (
+            LlamaConfig, generate, generate_speculative, init_params,
+        )
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+        cfg_fused = dataclasses.replace(cfg, decode_attn="fused")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        phrase = jax.random.randint(jax.random.PRNGKey(1), (6,), 0,
+                                    cfg.vocab)
+        prompt = jnp.tile(phrase, 3)[None, :]
+        ref = generate(params, prompt, cfg, max_new=8, max_len=40)
+        dense = generate_speculative(params, prompt, cfg, max_new=8,
+                                     gamma=4, max_len=40)
+        fused = generate_speculative(params, prompt, cfg_fused, max_new=8,
+                                     gamma=4, max_len=40)
+        assert jnp.array_equal(dense, ref)
+        assert jnp.array_equal(fused, ref)
+
+    def test_b1_restriction_still_enforced(self):
+        from k8s_gpu_scheduler_tpu.models import (
+            LlamaConfig, generate_speculative, init_params,
+        )
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), decode_attn="fused")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="B=1"):
+            generate_speculative(params, jnp.zeros((2, 4), jnp.int32),
+                                 cfg, max_new=4)
+
+
+class TestBenchLeg:
+    @pytest.mark.slow          # the dedicated CI step runs the same leg
+    def test_speculative_bench_smoke(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--leg", "speculative", "--smoke"],
+            cwd=repo, env=env, capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "speculative_bench"
+        e = rec["extra"]
+        assert e["spec_token_identity"] is True
+        assert e["spec_accept_rate"] > 0
+        assert e["spec_tokens_per_dispatch"] > 1.0
+        assert e["spec_on_tok_s"] > 0 and e["spec_off_tok_s"] > 0
