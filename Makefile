@@ -38,6 +38,7 @@ bench-smoke:
 	$(PY) bench.py --leg paged_attention --smoke
 	$(PY) bench.py --leg prefix_cache --smoke
 	$(PY) bench.py --leg speculative --smoke
+	$(PY) bench.py --leg chaos --smoke
 	$(PY) bench.py --leg decode_attention --smoke
 
 demo: native

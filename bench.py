@@ -1263,6 +1263,146 @@ def _bench_serving_8b_full():
     return stats
 
 
+def bench_chaos(smoke=False):
+    """Preemption-safe serving leg — the robustness PR's loop measured
+    end-to-end: a mixed workload is forced through a preemption at ~50%
+    completion (a seeded ``FaultRule`` preempt on the batcher's
+    ``serve.step`` hook), drained, snapshotted (models/snapshot.py),
+    and restored into a FRESH engine that finishes the run. Reports
+    drain ms, snapshot bytes, restore ms, resumed-request count, and
+    the ``chaos_token_identity`` bit (resumed streams byte-equal to the
+    uninterrupted reference) the CI step asserts; plus the
+    bounded-retry proof for the control-plane clients (a dead registry
+    costs exactly the attempt budget, inside the deadline, never a
+    hang) and the determinism bit (same fault seed → same injection
+    log → same streams). On CPU (or --smoke) the model is tiny/f32 —
+    numbers prove the loop end-to-end; the TPU run under the driver is
+    what BENCH_*.json captures."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+    from k8s_gpu_scheduler_tpu.models.snapshot import ServingSnapshot
+    from k8s_gpu_scheduler_tpu.testing.faults import (
+        FaultInjector, FaultRule, Preempted,
+    )
+    from k8s_gpu_scheduler_tpu.utils.retry import RetryPolicy
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if smoke or not on_tpu:
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+        n_req, max_new = 8, 12
+        eng_kw = dict(n_slots=4, max_len=96, chunk=4, prefill_bucket=16,
+                      kv_layout="paged", page_size=8, prefix_cache=True)
+    else:
+        cfg = LlamaConfig(
+            vocab=32000, d_model=1024, n_layers=4, n_heads=16,
+            n_kv_heads=16, d_ff=4096, max_seq=2048, remat=False,
+            decode_attn="fused")
+        n_req, max_new = 32, 48
+        eng_kw = dict(n_slots=8, max_len=2048, chunk=8,
+                      prefill_bucket=128, kv_layout="paged", page_size=64,
+                      kv_dtype="int8", prefix_cache=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    shared = list(rng.integers(0, cfg.vocab, 2 * eng_kw["page_size"]))
+    workload = [shared + list(rng.integers(0, cfg.vocab, 3 + i % 7))
+                for i in range(n_req)]
+
+    def engine(injector=None):
+        return ContinuousBatcher(params, cfg, fault_injector=injector,
+                                 **eng_kw)
+
+    # Uninterrupted reference (also counts the steps so the preempt can
+    # land at ~50% completion).
+    eng = engine()
+    ids = [eng.submit(p, max_new=max_new) for p in workload]
+    ref, steps = {}, 0
+    while eng.pending:
+        ref.update(eng.step())
+        steps += 1
+    ref = [ref[i] for i in ids]
+
+    def chaos_run():
+        inj = FaultInjector(seed=42, rules=[
+            FaultRule(site="serve.step", kind="preempt",
+                      at=[max(2, steps // 2)]),
+        ])
+        eng = engine(inj)
+        for p in workload:
+            eng.submit(p, max_new=max_new)
+        done = {}
+        try:
+            while eng.pending:
+                done.update(eng.step())
+            raise RuntimeError("injected preemption never fired")
+        except Preempted:
+            pass
+        snap = eng.drain()
+        nbytes = snap.nbytes()
+        # The persistence path's codec round trip (orbax itself is
+        # exercised in tests/test_snapshot_restore.py; the bench keeps
+        # the loop dependency-light).
+        snap = ServingSnapshot.from_pytree(snap.to_pytree())
+        fresh = engine()
+        t0 = time.perf_counter()
+        resumed = fresh.restore(snap)
+        restore_s = time.perf_counter() - t0
+        while fresh.pending:
+            done.update(fresh.step())
+        fresh._alloc.assert_consistent()
+        return ([done[i] for i in ids], inj.log, eng, resumed,
+                nbytes, restore_s)
+
+    toks, log1, drained_eng, resumed, snap_bytes, restore_s = chaos_run()
+    toks2, log2, *_ = chaos_run()          # determinism: same seed, again
+
+    # Bounded-retry proof, no server needed: a dead registry endpoint
+    # costs exactly the attempt budget inside the deadline.
+    from k8s_gpu_scheduler_tpu.registry.client import Client, ConnectionLost
+
+    retries = []
+    rc = Client(port=1, timeout_s=0.2,
+                retry=RetryPolicy(attempts=3, base_s=0.005, max_s=0.02,
+                                  jitter=0.5, deadline_s=2.0),
+                on_retry=lambda: retries.append(1))
+    t0 = time.perf_counter()
+    try:
+        rc.get("probe")
+        rpc_bounded = False                # a dead port must not succeed
+    except ConnectionLost:
+        rpc_bounded = (time.perf_counter() - t0) < 2.0 \
+            and len(retries) == 2
+    except Exception:  # noqa: BLE001 — unexpected error type = not bounded proof
+        rpc_bounded = False
+
+    extra = {
+        "chaos_shape": f"{n_req} reqs (shared {2 * eng_kw['page_size']}-tok "
+                       f"prefix), max_new {max_new}, preempt at step "
+                       f"{max(2, steps // 2)}/{steps}",
+        "chaos_interpret": not on_tpu,
+        "chaos_drain_ms": round(
+            drained_eng.pool_metrics()["drain_duration_seconds"] * 1e3, 2),
+        "chaos_snapshot_bytes": snap_bytes,
+        "chaos_restore_ms": round(restore_s * 1e3, 2),
+        "chaos_resumed_requests": resumed,
+        "chaos_token_identity": toks == ref and toks2 == ref,
+        "chaos_deterministic": log1 == log2 and bool(log1),
+        "chaos_rpc_retries_bounded": rpc_bounded,
+    }
+    return {
+        "metric": "chaos_bench",
+        "value": extra["chaos_restore_ms"],
+        "unit": "ms",
+        "extra": extra,
+    }
+
+
 def main(argv=None):
     args = list(sys.argv[1:] if argv is None else argv)
     if "--leg" in args:
@@ -1288,9 +1428,12 @@ def main(argv=None):
         if leg == "analysis":
             print(json.dumps(bench_analysis(smoke="--smoke" in args)))
             return
+        if leg == "chaos":
+            print(json.dumps(bench_chaos(smoke="--smoke" in args)))
+            return
         raise SystemExit(f"unknown bench leg: {leg!r} (available: "
                          f"decode_attention, paged_attention, prefix_cache, "
-                         f"speculative, analysis)")
+                         f"speculative, analysis, chaos)")
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
     # the bench measures the scheduler as deployed.
     sys.setswitchinterval(0.001)

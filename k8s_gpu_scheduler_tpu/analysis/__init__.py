@@ -21,6 +21,11 @@ k8s_gpu_scheduler_tpu.analysis``; importable APIs below):
    rule — dispatches the real prefill/decode programs against pools with
    declared shared pages and byte-verifies those pages came back
    untouched (an aliased-page write is silent KV cross-contamination).
+6. **Retry-lint** (``retrylint``, runs inside the AST pass): unbounded
+   ``while True`` retry loops (no attempt bound/deadline on the failure
+   path) and blocking sleeps/socket calls made while holding a lock —
+   the two anti-patterns utils/retry.py's bounded ``RetryPolicy``
+   replaces in the control-plane clients.
 
 Suppression: ``# graftcheck: ignore[rule]`` on the offending line, with a
 rationale in the surrounding comment (policy in README).
@@ -32,6 +37,7 @@ passes add a few seconds and run in the full CLI and their own tests.
 from .findings import ALL_RULES, Finding, Report, parse_suppressions
 from .alias import audit_shared_pages, check_shared_pages
 from .astlint import lint_source, run_astlint
+from .retrylint import lint_retry
 from .vmem import (
     VMEM_BYTES_PER_CORE, audit_vmem, decode_attention_footprint,
     flash_attention_footprint, paged_decode_attention_footprint,
@@ -44,6 +50,7 @@ __all__ = [
     "Report",
     "parse_suppressions",
     "lint_source",
+    "lint_retry",
     "run_astlint",
     "VMEM_BYTES_PER_CORE",
     "audit_vmem",

@@ -37,6 +37,10 @@ Inside those bodies:
   are themselves thread-safe primitives (Event/Thread/executors/queues)
   are never considered guarded, and nested functions are treated as
   lock-NOT-held (closures usually run on other threads).
+- ``unbounded-retry`` / ``blocking-io-under-lock``: the retry-lint pair
+  (retrylint.py) — ``while True`` retry loops whose failure path has no
+  attempt bound or deadline, and blocking sleeps/socket calls made while
+  holding a lock.
 """
 from __future__ import annotations
 
@@ -411,6 +415,8 @@ def lint_source(path: str, source: str) -> List[Finding]:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
         return [Finding("syntax-error", path, e.lineno or 0, str(e.msg))]
+    from .retrylint import lint_retry
+
     scopes = _Scopes(tree)
     traced = _collect_traced(tree, scopes)
     np_aliases = _numpy_aliases(tree)
@@ -425,6 +431,7 @@ def lint_source(path: str, source: str) -> List[Finding]:
         elif isinstance(node, ast.ClassDef):
             findings.extend(_lint_class_locks(path, node))
     findings.extend(_lint_module_wide(path, tree, traced))
+    findings.extend(lint_retry(path, tree))
     return apply_suppressions(findings, parse_suppressions(source))
 
 

@@ -52,6 +52,14 @@ def build_scheduler(server, config: SchedulerConfig,
     sched = Scheduler(server, profile=Profile(), config=config,
                       metrics=metrics, elector=elector)
 
+    # Bounded-retry visibility: both control-plane clients count each
+    # backoff retry here (utils/retry.py on_retry hook), labeled per
+    # client — the flap-rate signal that distinguishes "the registry is
+    # restarting" from "scoring went degraded" on one dashboard.
+    rpc_retries = sched.metrics.counter(
+        "tpu_sched_rpc_retries_total",
+        "Bounded control-plane RPC retries, by client")
+
     registry = None
     try:
         from ..registry.client import Client as RegistryClient
@@ -59,6 +67,7 @@ def build_scheduler(server, config: SchedulerConfig,
         registry = RegistryClient(
             config.registry.host, config.registry.port,
             password=config.registry.password,
+            on_retry=lambda: rpc_retries.inc(client="registry"),
         )
         registry.ping()
         log.info("registry connected at %s:%d",
@@ -74,6 +83,7 @@ def build_scheduler(server, config: SchedulerConfig,
         recommender = RecommenderClient(
             config.recommender.host, config.recommender.port,
             timeout_s=config.recommender.timeout_s,
+            on_retry=lambda: rpc_retries.inc(client="recommender"),
         )
         recommender.impute_configurations("startup-probe")
         log.info("recommender connected at %s:%d",
@@ -99,7 +109,8 @@ def build_scheduler(server, config: SchedulerConfig,
                              auto_confirm_delay_s=0.0 if registry else 2.0,
                              simulate_without_registry=allow_simulated_reshape)
     tpu = TPUPlugin(sched.handle, registry=registry, prom=prom,
-                    recommender=recommender, reshaper=reshaper)
+                    recommender=recommender, reshaper=reshaper,
+                    metrics=sched.metrics)
     gang = GangPlugin(sched.handle)
     preempt = PreemptionPlugin(sched.handle, filter_plugins=[tpu, gang], tpu=tpu)
     sched.profile = Profile(

@@ -202,6 +202,18 @@ SERVING_POOL_GAUGES = {
         "tokens committed per active slot per verify dispatch",
     "spec_rewound_tokens_total":
         "cumulative rejected overshoot rows rewound by the lens clamp",
+    # Lifecycle robustness (drain/snapshot/restore + watchdog —
+    # models/serving.py drain()/restore(), models/snapshot.py).
+    "drain_duration_seconds":
+        "wall time of the last engine drain (flush + page gather)",
+    "restore_duration_seconds":
+        "wall time of the last snapshot restore (re-layout + scatter)",
+    "requests_resumed_total":
+        "interrupted requests resumed by the last restore",
+    "request_errors_total":
+        "poison requests failed in isolation (step loop error containment)",
+    "last_step_age_seconds":
+        "seconds since the last batcher step started (liveness watchdog)",
 }
 
 

@@ -181,6 +181,36 @@ class PrefixCache:
                 freed += 1
         return freed
 
+    def dump_paths(self) -> List[Tuple[List[int], List[int]]]:
+        """The tree as root-to-LEAF ``(tokens, pages)`` paths, ordered by
+        the leaf's LRU clock (coldest first) — the serializable form a
+        drain snapshot carries (models/snapshot.py). Every node lies on
+        at least one leaf path, so replaying the paths through
+        ``insert`` in this order rebuilds the whole tree: shared prefix
+        nodes are created by the first (coldest) path that walks them
+        and de-duplicated by the later ones, and inserting coldest-first
+        reproduces the eviction order at leaf granularity — the
+        restored tree evicts the same suffixes first."""
+        leaves: List[_Node] = []
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            if node is not self._root and not node.children:
+                leaves.append(node)
+            stack.extend(node.children.values())
+        leaves.sort(key=lambda n: n.last_used)
+        paths: List[Tuple[List[int], List[int]]] = []
+        for leaf in leaves:
+            tokens: List[int] = []
+            pages: List[int] = []
+            node = leaf
+            while node is not self._root:
+                tokens[:0] = node.chunk
+                pages.insert(0, node.page)
+                node = node.parent
+            paths.append((tokens, pages))
+        return paths
+
     def metrics(self) -> Dict[str, float]:
         """Prefix-reuse counters for pool_metrics()/the exporter: token
         and request hit rates, cached-page count, adoption/eviction

@@ -68,9 +68,11 @@ from ..ops.decode_attention import (
 )
 from ..ops.layers import apply_rope, rms_norm, rope_freqs
 from ..ops.quant import qdot
+from ..testing.faults import Preempted
 from .llama import LlamaConfig, _constrain, mlp_sublayer
 from .paging import NULL_PAGE, PageAllocator
 from .prefix_cache import PrefixCache
+from .snapshot import ServingSnapshot, SnapshotError, check_fingerprint
 
 _NEG_INF = -1e30
 
@@ -1013,8 +1015,31 @@ class ContinuousBatcher:
                  page_size: int = DEFAULT_PAGE_SIZE,
                  n_pages: Optional[int] = None,
                  prefix_cache: bool = False,
-                 speculative: bool = False, gamma: int = 4):
+                 speculative: bool = False, gamma: int = 4,
+                 fault_injector=None):
         self.params = params
+        # Chaos harness hook (testing/faults.py): the step loop fires
+        # ``serve.step`` (drop/delay/preempt/page-pressure) and the
+        # speculative proposer fires ``serve.propose`` per slot. None in
+        # production — one `is None` check per step.
+        self._faults = fault_injector
+        self._chaos_pages: list = []         # page-pressure hostages
+        # Lifecycle robustness (drain/snapshot/restore — models/snapshot
+        # .py): a drained engine refuses further work; restore() fills a
+        # FRESH engine from a snapshot. Per-request error isolation
+        # (``errors``) records poison-request failures without
+        # unwinding the step for the other slots.
+        self._drained = False
+        self._drain_s: Optional[float] = None
+        self._restore_s: Optional[float] = None
+        self._resumed = 0
+        self._request_errors = 0
+        self.errors: Dict[int, str] = {}
+        # Watchdog/liveness: monotonic timestamp of the last step start —
+        # pool_metrics() derives tpu_serve_last_step_age_seconds from it,
+        # the gauge an external liveness probe alerts on when the step
+        # loop wedges (the failure drain/restore exists to bound).
+        self._last_step_t = time.monotonic()
         self.cfg = cfg
         self.n_slots = n_slots
         self.chunk = chunk
@@ -1237,6 +1262,10 @@ class ContinuousBatcher:
     def submit(self, prompt, max_new: int) -> int:
         """Queue one request; returns its id. prompt: 1-D int sequence up
         to the cache capacity (padded to the next bucket rung)."""
+        if self._drained:
+            raise RuntimeError(
+                "engine is drained: admission is stopped; restore() the "
+                "snapshot into a fresh engine")
         prompt = list(int(t) for t in prompt)
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
@@ -1326,6 +1355,17 @@ class ContinuousBatcher:
         ``device_get``: a drain costs ONE tunnel round trip total instead
         of one per chunk (the per-step readback was 98% of the serving
         bench — 0.88 s of a 0.90 s run — with dispatches at ~3 ms)."""
+        if self._drained:
+            raise RuntimeError(
+                "engine is drained: restore() the snapshot into a fresh "
+                "engine")
+        self._last_step_t = time.monotonic()
+        if self._faults is not None:
+            # Chaos hook: may raise (drop → InjectedFault, preempt →
+            # Preempted — the in-process SIGTERM the drain/restore loop
+            # catches) BEFORE any state changes this step; passive
+            # page-pressure rules are applied to the allocator.
+            self._apply_page_pressure(self._faults.fire("serve.step"))
         if self.layout == "paged":
             if self.spec:
                 return self._step_spec_paged()
@@ -1699,8 +1739,23 @@ class ContinuousBatcher:
         # _out before the verify's direct appends below).
         self._flush()
         props = np.zeros((self.n_slots, self.gamma), np.int32)
-        for slot, rid in self._slot_req.items():
-            props[slot] = self._propose(slot, rid)
+        for slot, rid in list(self._slot_req.items()):
+            # Per-request error isolation: a poison request (host-side
+            # failure building ITS proposal — chaos hook serve.propose,
+            # or a genuine assert in the mirror/bigram code) fails THAT
+            # request with a recorded error; the other slots' proposals,
+            # pages and streams are untouched. Preempted passes through:
+            # it is the whole-engine drain signal, not a request fault.
+            try:
+                if self._faults is not None:
+                    self._faults.fire("serve.propose")
+                props[slot] = self._propose(slot, rid)
+            except Preempted:
+                raise
+            except Exception as e:  # noqa: BLE001 — isolate the poison request
+                self._fail_request(slot, rid, e)
+        if not self._slot_req:                       # every slot poisoned
+            return finished
         active = np.asarray(
             [s in self._slot_req for s in range(self.n_slots)])
         table = self._device_table()
@@ -1735,6 +1790,286 @@ class ContinuousBatcher:
                 self._free_slot_pages(slot)          # pages free NOW too
         return finished
 
+    # -- chaos / error isolation -------------------------------------------
+    def _apply_page_pressure(self, rules) -> None:
+        """Apply the passive ``page_pressure`` rules the step hook
+        returned: hold the largest requested hostage count out of the
+        allocator (as many as are actually free — pressure takes what is
+        there, it never fabricates pages), and release the hostages the
+        moment no rule wants them. Chaos tests use this to force the
+        admission path through its page-shortage branches (strict-FCFS
+        head blocking, prefix-cache eviction) on a seeded schedule."""
+        if self.layout != "paged":
+            return
+        want = max((r.pages for r in rules), default=0)
+        held = len(self._chaos_pages)
+        if want > held:
+            take = min(want - held, self._alloc.free_count)
+            if take:
+                got = self._alloc.alloc(take, count_denied=False)
+                if got:
+                    self._chaos_pages.extend(got)
+        elif want < held:
+            release = self._chaos_pages[want:]
+            del self._chaos_pages[want:]
+            self._alloc.free(release)
+
+    def _fail_request(self, slot: int, rid: int, exc: BaseException) -> None:
+        """Per-request error isolation: a poison request (host-side
+        failure while building ITS proposal/admission state) fails with a
+        recorded error instead of unwinding the step — every other active
+        slot keeps its pages and its stream. The slot and its whole page
+        reservation return to the pool; the error text lands in
+        ``self.errors`` for the caller."""
+        self.errors[rid] = f"{type(exc).__name__}: {exc}"
+        self._request_errors += 1
+        self._slot_req.pop(slot, None)
+        self._budget.pop(rid, None)
+        self._eos_scanned.pop(rid, None)
+        if self.spec:
+            self._spec_mirror.pop(slot, None)
+        if self.layout == "paged" and slot in self._slot_pages:
+            self._free_slot_pages(slot)
+        self._out.pop(rid, None)
+        self._arrival.pop(rid, None)
+        self._first_tok.pop(rid, None)
+
+    # -- lifecycle: drain / snapshot / restore -----------------------------
+    def fingerprint(self) -> Dict[str, object]:
+        """The engine-compat contract a snapshot carries: everything that
+        must match for restored page bytes to be addressed and decoded
+        identically (layout/page geometry/dtypes/model dims) plus the
+        scheduling knobs the slot state already encodes worst-case page
+        reservations for (chunk, spec, gamma). ``n_pages`` is recorded
+        but EXEMPT from the restore check — pages are re-laid-out through
+        the fresh allocator, so pool size may differ (snapshot.py
+        check_fingerprint). Model WEIGHTS are the caller's obligation:
+        restore into an engine holding different params resumes streams
+        that decode differently, and no fingerprint can see that."""
+        cfg = self.cfg
+        fp: Dict[str, object] = {
+            "layout": self.layout,
+            "kv_dtype": self.kv_dtype,
+            "dtype": jnp.dtype(cfg.dtype).name,
+            "decode_attn": getattr(cfg, "decode_attn", "dense"),
+            "n_layers": cfg.n_layers,
+            "n_kv_heads": cfg.n_kv_heads,
+            "n_heads": cfg.n_heads,
+            "head_dim": cfg.head_dim,
+            "vocab": cfg.vocab,
+            "n_slots": self.n_slots,
+            "chunk": self.chunk,
+            "bucket": self.bucket,
+            "capacity": self.S,
+            "eos_id": self.eos_id,
+            "temperature": self.temperature,
+            "top_k": self.top_k,
+            "speculative": self.spec,
+            "gamma": self.gamma if self.spec else None,
+            "prefix_cache": (self.layout == "paged"
+                             and self._prefix is not None),
+        }
+        if self.layout == "paged":
+            fp["page_size"] = self.page_size
+            fp["n_pages"] = self._alloc.n_pages
+        return fp
+
+    def drain(self) -> ServingSnapshot:
+        """Stop admission and serialize the whole in-flight state machine
+        to host: the preemption path's first half (the SIGTERM handler
+        calls this, persists the snapshot through utils/checkpoint.py,
+        and exits; ``restore`` on a fresh engine is the second half).
+
+        Deferred readbacks are flushed first (one tunnel round trip —
+        tokens a client could already have been sent must survive), then
+        every REFERENCED pool page (live slots' own + mounted shared +
+        prefix-tree pages; free pages are garbage by contract) is
+        gathered to host along with the block tables, ``lens``, per-slot
+        bindings, budgets, emitted streams, the waiting queue, and the
+        radix tree as token-keyed paths. Speculative proposals are
+        deliberately NOT captured — they are a pure function of
+        prompt + emitted stream and are re-proposed after restore.
+        The engine refuses further submit/step afterwards."""
+        if self.layout != "paged":
+            raise SnapshotError(
+                "drain() requires kv_layout='paged' (the snapshot format "
+                "is pool pages + block tables)")
+        if self._drained:
+            raise RuntimeError("engine already drained")
+        t0 = time.perf_counter()
+        self._flush()
+        if self._chaos_pages:                # chaos hostages are not state
+            self._alloc.free(self._chaos_pages)
+            self._chaos_pages = []
+        ids: list = []
+        seen: set = set()
+
+        def add(pages):
+            for p in pages:
+                p = int(p)
+                if p != NULL_PAGE and p not in seen:
+                    seen.add(p)
+                    ids.append(p)
+
+        for slot in sorted(self._slot_req):
+            add(self._slot_shared.get(slot, ()))
+            add(self._slot_pages.get(slot, ()))
+        tree_paths = (self._prefix.dump_paths()
+                      if self._prefix is not None else [])
+        for _, pages in tree_paths:
+            add(pages)
+
+        if ids:
+            idx = np.asarray(ids, np.int32)
+            # graftcheck: ignore[host-sync] — sanctioned: the drain IS the readback (one gather of live+cached pages per preemption)
+            gathered = jax.device_get(
+                [self._k[:, idx], self._v[:, idx]]
+                + ([self._ks[:, idx], self._vs[:, idx]]
+                   if self._ks is not None else []))
+        else:
+            empty = (self.cfg.n_layers, 0, self.page_size,
+                     self.cfg.n_kv_heads, self.cfg.head_dim)
+            gathered = [np.zeros(empty, self._k.dtype) for _ in range(2)]
+            if self._ks is not None:
+                gathered += [np.zeros(empty[:-1] + (1,), np.float32)
+                             for _ in range(2)]
+        # graftcheck: ignore[host-sync] — sanctioned: drain-time readback of two [n_slots] vectors
+        lens, last = jax.device_get((self._lens, self._last))
+        snap = ServingSnapshot(
+            fingerprint=self.fingerprint(),
+            page_ids=ids,
+            k_pages=np.asarray(gathered[0]),
+            v_pages=np.asarray(gathered[1]),
+            k_scales=(np.asarray(gathered[2])
+                      if self._ks is not None else None),
+            v_scales=(np.asarray(gathered[3])
+                      if self._ks is not None else None),
+            table=self._table_np.copy(),
+            lens=np.asarray(lens, np.int32),
+            last=np.asarray(last, np.int32),
+            slot_req={int(s): int(r) for s, r in self._slot_req.items()},
+            slot_pages={int(s): [int(p) for p in pg]
+                        for s, pg in self._slot_pages.items()},
+            slot_shared={int(s): [int(p) for p in pg]
+                         for s, pg in self._slot_shared.items()},
+            slot_prompt={int(s): [int(t) for t in pr]
+                         for s, pr in self._slot_prompt.items()},
+            budgets={int(r): int(b) for r, b in self._budget.items()},
+            out={int(r): [int(t) for t in ts]
+                 for r, ts in self._out.items()},
+            queue=[(int(r), [int(t) for t in pr])
+                   for r, pr in self._queue],
+            next_id=self._next_id,
+            eos_scanned={int(r): int(n)
+                         for r, n in self._eos_scanned.items()},
+            tree_paths=tree_paths,
+            arrival=dict(self._arrival),
+            first_tok=dict(self._first_tok),
+            drained_mono=time.monotonic(),
+            drained_wall=time.time(),
+            skipped_tokens=self._skipped_tokens,
+        )
+        snap.validate()
+        self._drained = True
+        self._drain_s = time.perf_counter() - t0
+        return snap
+
+    def restore(self, snap: ServingSnapshot) -> int:
+        """Fill THIS (fresh) engine from a drained snapshot and resume
+        every interrupted stream token-identically to an uninterrupted
+        run. Physical page ids need not match — the snapshot's pages are
+        re-laid-out through this engine's allocator (same or different
+        ``n_pages``; raises when they simply don't fit) and every block
+        table, slot page list and tree path is remapped. Refcounts are
+        rebuilt exactly: each restored page starts at refcount 1 (its
+        owner — a slot's own page, or the tree's reference labeled via
+        the insert/adopt path), and each mounting slot's ``retain`` adds
+        its share, so ``PageAllocator.assert_consistent`` holds by
+        construction (and is asserted). Latency clocks are re-based so
+        TTFT/latency records keep charging the real downtime. Token
+        identity is a GREEDY guarantee: sampled streams
+        (temperature > 0) are seeded per dispatch from a counter the
+        fresh engine restarts, so they stay valid samples but not the
+        same ones. Returns the number of resumed requests (in-flight +
+        queued)."""
+        if self.layout != "paged":
+            raise SnapshotError("restore() requires kv_layout='paged'")
+        if self._drained:
+            raise RuntimeError(
+                "cannot restore into a drained engine — build a fresh one")
+        if (self._slot_req or self._queue or self._next_id
+                or self._reads or self._alloc.in_use):
+            raise SnapshotError(
+                "restore() needs a FRESH engine (no admitted slots, no "
+                "queue, no allocated pages)")
+        check_fingerprint(snap.fingerprint, self.fingerprint())
+        snap.validate()
+        t0 = time.perf_counter()
+        new = self._alloc.alloc(len(snap.page_ids))
+        if new is None:
+            raise SnapshotError(
+                f"snapshot references {len(snap.page_ids)} pages but the "
+                f"pool has only {self._alloc.free_count} free")
+        lut = np.full(max(snap.page_ids, default=0) + 1, -1, np.int64)
+        lut[NULL_PAGE] = NULL_PAGE
+        for old, nw in zip(snap.page_ids, new):
+            lut[old] = nw
+        if new:
+            idx = np.asarray(new, np.int32)
+            self._k = self._k.at[:, idx].set(
+                jnp.asarray(snap.k_pages, self._k.dtype))
+            self._v = self._v.at[:, idx].set(
+                jnp.asarray(snap.v_pages, self._v.dtype))
+            if self._ks is not None:
+                if snap.k_scales is None:
+                    raise SnapshotError(
+                        "int8-KV engine but snapshot has no scale planes")
+                self._ks = self._ks.at[:, idx].set(
+                    jnp.asarray(snap.k_scales, jnp.float32))
+                self._vs = self._vs.at[:, idx].set(
+                    jnp.asarray(snap.v_scales, jnp.float32))
+        table = np.asarray(snap.table, np.int64)
+        if table.shape != self._table_np.shape:
+            raise SnapshotError(
+                f"block table shape {table.shape} != "
+                f"{self._table_np.shape}")
+        if table.max(initial=0) >= len(lut) or (lut[table] < 0).any():
+            raise SnapshotError(
+                "block table references pages the snapshot did not ship")
+        self._table_np = lut[table].astype(np.int32)
+        self._table_dirty = True
+        self._lens = jnp.asarray(snap.lens, jnp.int32)
+        self._last = jnp.asarray(snap.last, jnp.int32)
+        remap = lambda pages: [int(lut[p]) for p in pages]  # noqa: E731
+        if snap.tree_paths and self._prefix is None:
+            raise SnapshotError(
+                "snapshot carries a prefix tree but prefix_cache=False")
+        for tokens, pages in snap.tree_paths:
+            self._prefix.insert(tokens, remap(pages))
+        self._slot_req = dict(snap.slot_req)
+        self._slot_pages = {s: remap(pg)
+                            for s, pg in snap.slot_pages.items()}
+        self._slot_shared = {s: remap(pg)
+                             for s, pg in snap.slot_shared.items()}
+        for pg in self._slot_shared.values():
+            if pg:
+                self._alloc.retain(pg)
+        self._slot_prompt = {s: list(pr)
+                             for s, pr in snap.slot_prompt.items()}
+        self._budget = dict(snap.budgets)
+        self._out = {r: list(ts) for r, ts in snap.out.items()}
+        self._queue = [(r, list(pr)) for r, pr in snap.queue]
+        self._next_id = snap.next_id
+        self._eos_scanned = dict(snap.eos_scanned)
+        self._skipped_tokens = snap.skipped_tokens
+        now_m, now_w = time.monotonic(), time.time()
+        self._arrival = snap.rebased_clock(snap.arrival, now_m, now_w)
+        self._first_tok = snap.rebased_clock(snap.first_tok, now_m, now_w)
+        self._alloc.assert_consistent()
+        self._resumed = snap.n_requests_in_flight
+        self._restore_s = time.perf_counter() - t0
+        return self._resumed
+
     def pool_metrics(self) -> Dict[str, float]:
         """Page-pool health (paged layout only; {} otherwise): total/free/
         in-use/cached/watermark page counts, alloc/free/denied churn, the
@@ -1747,6 +2082,22 @@ class ContinuousBatcher:
         if self.layout != "paged":
             return {}
         out = self._alloc.metrics()
+        # Lifecycle/robustness gauges (metrics.exporter maps these onto
+        # tpu_serve_drain_duration_seconds etc.): drain/restore cost, the
+        # resumed-request handoff count, per-request isolated failures,
+        # and the watchdog age of the last step start — the liveness
+        # signal an external probe alerts on when the step loop wedges.
+        out["drain_duration_seconds"] = self._drain_s or 0.0
+        out["restore_duration_seconds"] = self._restore_s or 0.0
+        out["requests_resumed_total"] = float(self._resumed)
+        out["request_errors_total"] = float(self._request_errors)
+        # Age is only a wedge signal while there is work to step: an
+        # idle engine (nothing queued, no active slots) legitimately
+        # stops stepping, and reporting its quiet time would page the
+        # probe on every traffic lull.
+        out["last_step_age_seconds"] = (
+            max(0.0, time.monotonic() - self._last_step_t)
+            if self.pending else 0.0)
         if self._prefix is not None:
             out.update(self._prefix.metrics())
             out["prefill_tokens_skipped"] = float(self._skipped_tokens)

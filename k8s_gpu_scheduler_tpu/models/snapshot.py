@@ -1,0 +1,259 @@
+"""Serving-engine snapshots — preemption-safe drain/restore state.
+
+TPU slices on GKE are preempted routinely (spot reclaim, maintenance
+events); the scheduler exists to keep inference SLOs under exactly that
+churn, yet until this module a preempted serving engine lost every
+in-flight request. The paged ``ContinuousBatcher`` makes recovery cheap
+because its entire state machine is already explicit and host-legible:
+K/V live in fixed-size pool pages addressed by per-slot block tables,
+``lens`` is simultaneously each slot's rope position / write address /
+attention bound, and the radix prefix cache is just pages plus a
+token-keyed tree. A :class:`ServingSnapshot` is that state machine
+serialized:
+
+- the KV **bytes of every referenced page** (live slot pages + prefix-
+  cache pages; free pages are garbage by contract and are not shipped),
+  gathered to host as ``[L, R, ps, Hkv, hd]`` arrays plus the int8 scale
+  planes when the cache is quantized;
+- the **page-id space**: which old pool ids those R rows were — restore
+  re-lays them out through the fresh engine's allocator, so physical ids
+  need not (and usually do not) match, and the restore pool may have a
+  DIFFERENT ``n_pages`` than the drained one;
+- the **per-slot machine**: block-table rows, ``lens``, ``last`` tokens,
+  slot↔request binding, owned/shared page lists, prompt token mirrors;
+- the **host bookkeeping**: remaining budgets, emitted streams, the
+  waiting queue, eos scan offsets, request-id counter, arrival/TTFT
+  clocks (re-based at restore so latency records survive a process
+  boundary);
+- the **prefix tree** as root-to-leaf token paths with their page ids,
+  in LRU order, so reuse state survives too.
+
+What is deliberately NOT preserved: speculative proposals (recomputed
+from the token mirrors — the bigram index is a pure function of
+prompt + emitted stream), deferred readbacks (drain flushes them), and
+cumulative gauge counters (a restored engine starts fresh counters; the
+``requests_resumed_total`` gauge records the handoff).
+
+The snapshot runs through ``utils/checkpoint.py``'s orbax machinery via
+``to_pytree``/``from_pytree``: every field becomes a numpy array (the
+host bookkeeping rides as one JSON document encoded to uint8), so
+``TrainCheckpointer.save(step, snap.to_pytree())`` just works and the
+restore side needs no custom readers.
+"""
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+SNAPSHOT_VERSION = 1
+
+
+class SnapshotError(ValueError):
+    """Snapshot/engine mismatch: restoring this snapshot into that
+    engine cannot preserve the token streams (or cannot fit)."""
+
+
+@dataclass
+class ServingSnapshot:
+    """One drained paged serving engine, host-resident. Produced by
+    ``ContinuousBatcher.drain()``, consumed by ``.restore()``; see the
+    module docstring for what each field carries."""
+
+    fingerprint: Dict[str, Any]            # engine-compat contract
+    page_ids: List[int]                    # old pool ids of the R rows
+    k_pages: np.ndarray                    # [L, R, ps, Hkv, hd]
+    v_pages: np.ndarray
+    k_scales: Optional[np.ndarray]         # [L, R, ps, Hkv, 1] (int8 mode)
+    v_scales: Optional[np.ndarray]
+    table: np.ndarray                      # [n_slots, n_blocks] old ids
+    lens: np.ndarray                       # [n_slots] int32
+    last: np.ndarray                       # [n_slots] int32
+    slot_req: Dict[int, int]               # slot -> req id
+    slot_pages: Dict[int, List[int]]       # slot -> owned old page ids
+    slot_shared: Dict[int, List[int]]      # slot -> mounted shared ids
+    slot_prompt: Dict[int, List[int]]      # slot -> prompt tokens
+    budgets: Dict[int, int]                # req id -> tokens remaining
+    out: Dict[int, List[int]]              # req id -> emitted tokens
+    queue: List[Tuple[int, List[int]]]     # waiting (req id, prompt)
+    next_id: int
+    eos_scanned: Dict[int, int]
+    tree_paths: List[Tuple[List[int], List[int]]]  # (tokens, pages), LRU order
+    arrival: Dict[int, float] = field(default_factory=dict)
+    first_tok: Dict[int, float] = field(default_factory=dict)
+    drained_mono: float = 0.0              # time.monotonic() at drain
+    drained_wall: float = 0.0              # time.time() at drain
+    skipped_tokens: int = 0
+
+    # -- derived -----------------------------------------------------------
+    @property
+    def n_requests_in_flight(self) -> int:
+        """Interrupted requests this snapshot can resume: slots mid-decode
+        plus the still-waiting queue."""
+        return len(self.slot_req) + len(self.queue)
+
+    def nbytes(self) -> int:
+        """Approximate serialized size — the number the bench leg reports
+        (page payload dominates; the JSON sidecar is KiBs)."""
+        n = self.k_pages.nbytes + self.v_pages.nbytes
+        if self.k_scales is not None:
+            n += self.k_scales.nbytes + self.v_scales.nbytes
+        n += self.table.nbytes + self.lens.nbytes + self.last.nbytes
+        n += len(json.dumps(self._meta_doc()).encode())
+        return n
+
+    def validate(self) -> None:
+        """Internal consistency: every page id referenced by a slot row or
+        tree path must be in ``page_ids`` (its bytes shipped), page ids
+        unique, array row count == len(page_ids)."""
+        ids = list(self.page_ids)
+        if len(ids) != len(set(ids)):
+            raise SnapshotError(f"duplicate page ids in snapshot: {ids}")
+        have = set(ids)
+        if self.k_pages.shape[1] != len(ids) or \
+                self.v_pages.shape[1] != len(ids):
+            raise SnapshotError(
+                f"page payload rows {self.k_pages.shape[1]} != "
+                f"{len(ids)} page ids")
+        referenced: set = set()
+        for slot, pages in self.slot_pages.items():
+            referenced.update(pages)
+        for slot, pages in self.slot_shared.items():
+            referenced.update(pages)
+        for _, pages in self.tree_paths:
+            referenced.update(pages)
+        missing = referenced - have
+        if missing:
+            raise SnapshotError(
+                f"referenced pages missing payloads: {sorted(missing)}")
+        for rid in self.slot_req.values():
+            if rid not in self.budgets:
+                raise SnapshotError(f"in-flight request {rid} has no budget")
+
+    # -- pytree codec ------------------------------------------------------
+    def _meta_doc(self) -> Dict[str, Any]:
+        """The host bookkeeping as one JSON-safe document. Dicts with int
+        keys ride as pair lists (JSON would silently stringify the
+        keys)."""
+        return {
+            "version": SNAPSHOT_VERSION,
+            "fingerprint": self.fingerprint,
+            "page_ids": [int(p) for p in self.page_ids],
+            "slot_req": [[int(s), int(r)] for s, r in self.slot_req.items()],
+            "slot_pages": [[int(s), [int(p) for p in pg]]
+                           for s, pg in self.slot_pages.items()],
+            "slot_shared": [[int(s), [int(p) for p in pg]]
+                            for s, pg in self.slot_shared.items()],
+            "slot_prompt": [[int(s), [int(t) for t in pr]]
+                            for s, pr in self.slot_prompt.items()],
+            "budgets": [[int(r), int(b)] for r, b in self.budgets.items()],
+            "out": [[int(r), [int(t) for t in ts]]
+                    for r, ts in self.out.items()],
+            "queue": [[int(r), [int(t) for t in pr]]
+                      for r, pr in self.queue],
+            "next_id": int(self.next_id),
+            "eos_scanned": [[int(r), int(n)]
+                            for r, n in self.eos_scanned.items()],
+            "tree_paths": [[[int(t) for t in toks], [int(p) for p in pgs]]
+                           for toks, pgs in self.tree_paths],
+            "arrival": [[int(r), float(t)] for r, t in self.arrival.items()],
+            "first_tok": [[int(r), float(t)]
+                          for r, t in self.first_tok.items()],
+            "drained_mono": float(self.drained_mono),
+            "drained_wall": float(self.drained_wall),
+            "skipped_tokens": int(self.skipped_tokens),
+        }
+
+    def to_pytree(self) -> Dict[str, np.ndarray]:
+        """A pure-numpy pytree (orbax StandardSave-compatible): arrays as
+        themselves, host bookkeeping as JSON bytes in a uint8 vector."""
+        meta = np.frombuffer(
+            json.dumps(self._meta_doc()).encode("utf-8"), dtype=np.uint8
+        ).copy()
+        tree: Dict[str, np.ndarray] = {
+            "meta_json": meta,
+            "k_pages": np.asarray(self.k_pages),
+            "v_pages": np.asarray(self.v_pages),
+            "table": np.asarray(self.table),
+            "lens": np.asarray(self.lens),
+            "last": np.asarray(self.last),
+        }
+        if self.k_scales is not None:
+            tree["k_scales"] = np.asarray(self.k_scales)
+            tree["v_scales"] = np.asarray(self.v_scales)
+        return tree
+
+    @classmethod
+    def from_pytree(cls, tree: Dict[str, np.ndarray]) -> "ServingSnapshot":
+        meta_arr = np.asarray(tree["meta_json"], dtype=np.uint8)
+        doc = json.loads(bytes(meta_arr.tobytes()).decode("utf-8"))
+        if doc.get("version") != SNAPSHOT_VERSION:
+            raise SnapshotError(
+                f"snapshot version {doc.get('version')} != "
+                f"{SNAPSHOT_VERSION}")
+        pairs = lambda key: {k: v for k, v in doc[key]}  # noqa: E731
+        snap = cls(
+            fingerprint=doc["fingerprint"],
+            page_ids=list(doc["page_ids"]),
+            k_pages=np.asarray(tree["k_pages"]),
+            v_pages=np.asarray(tree["v_pages"]),
+            k_scales=(np.asarray(tree["k_scales"])
+                      if "k_scales" in tree else None),
+            v_scales=(np.asarray(tree["v_scales"])
+                      if "v_scales" in tree else None),
+            table=np.asarray(tree["table"]),
+            lens=np.asarray(tree["lens"]),
+            last=np.asarray(tree["last"]),
+            slot_req=pairs("slot_req"),
+            slot_pages=pairs("slot_pages"),
+            slot_shared=pairs("slot_shared"),
+            slot_prompt=pairs("slot_prompt"),
+            budgets=pairs("budgets"),
+            out=pairs("out"),
+            queue=[(r, list(p)) for r, p in doc["queue"]],
+            next_id=doc["next_id"],
+            eos_scanned=pairs("eos_scanned"),
+            tree_paths=[(list(t), list(p)) for t, p in doc["tree_paths"]],
+            arrival=pairs("arrival"),
+            first_tok=pairs("first_tok"),
+            drained_mono=doc["drained_mono"],
+            drained_wall=doc["drained_wall"],
+            skipped_tokens=doc["skipped_tokens"],
+        )
+        snap.validate()
+        return snap
+
+    # -- clock re-basing ---------------------------------------------------
+    def rebased_clock(self, rid_ts: Dict[int, float],
+                      now_mono: float, now_wall: float) -> Dict[int, float]:
+        """Translate drained ``time.monotonic`` timestamps into the
+        restoring process's monotonic frame, charging the real downtime
+        (wall-clock drain→restore) to every in-flight request:
+        ``now - new_ts == (drained_mono - old_ts) + downtime``. Across a
+        process boundary the raw values would be meaningless (monotonic
+        clocks share no epoch); rebased, TTFT/latency records stay
+        honest — including the preemption gap itself."""
+        downtime = max(0.0, now_wall - self.drained_wall)
+        return {
+            rid: now_mono - downtime - (self.drained_mono - ts)
+            for rid, ts in rid_ts.items()
+        }
+
+
+def check_fingerprint(snap_fp: Dict[str, Any],
+                      engine_fp: Dict[str, Any]) -> None:
+    """Every fingerprint key except the pool size must match: page_size/
+    layout/dtype mismatches would silently corrupt KV addressing, and
+    chunk/gamma/spec mismatches would break the worst-case page
+    reservations already encoded in the slot state. ``n_pages`` is
+    exempt — re-layout through the allocator is the design."""
+    for key in sorted(set(snap_fp) | set(engine_fp)):
+        if key == "n_pages":
+            continue
+        if snap_fp.get(key) != engine_fp.get(key):
+            raise SnapshotError(
+                f"snapshot/engine mismatch on {key!r}: snapshot has "
+                f"{snap_fp.get(key)!r}, engine has {engine_fp.get(key)!r}")

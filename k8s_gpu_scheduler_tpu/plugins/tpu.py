@@ -195,12 +195,25 @@ class TPUPlugin(
         prom=None,
         recommender: Optional[PredictionClient] = None,
         reshaper=None,
+        metrics=None,
     ) -> None:
         self.handle = handle
         self.registry = registry
         self.prom = prom
         self.recommender = recommender
         self.reshaper = reshaper
+        # Degraded-scoring accounting (metrics: a metrics.exporter
+        # Registry or None): when a recommender RPC exhausts its bounded
+        # retries (recommender/client.py RetryPolicy), the cycle SCORES
+        # WITHOUT that signal — skip, log once per outage, count — never
+        # fails the pod. A scheduler that dies with its advisor inverts
+        # the dependency hierarchy: predictions improve placement, their
+        # absence must only degrade it.
+        self._m_degraded = (metrics.counter(
+            "tpu_sched_score_degraded_total",
+            "Score decisions that skipped a failing signal source")
+            if metrics is not None else None)
+        self._recommender_down = False
         self.weight = handle.config.tpu_score_weight
         # Register the ConfigMap informer NOW (before factory.start()) so
         # Score's assignment readbacks hit the lister cache instead of one
@@ -549,6 +562,35 @@ class TPUPlugin(
         self._fill_sharing_limits(decision, topo, partitions, inv)
         return decision, score
 
+    def _impute(self, kind: str, index: str) -> Dict[str, float]:
+        """Recommender prediction with graceful degradation: a client
+        whose bounded retries are spent (deadline expired, attempts
+        exhausted — recommender/client.py) raises, and the answer here
+        is the EMPTY prediction — every downstream consumer already
+        treats a missing column as "no signal", so the cycle completes
+        with utilization/latency-only scoring instead of dying. Logged
+        once per outage transition (not per call — Score makes 2 calls
+        per resident pod per node) and counted per skipped signal so the
+        degradation is visible on /metrics while it lasts."""
+        assert self.recommender is not None
+        fn = (self.recommender.impute_configurations if kind == "conf"
+              else self.recommender.impute_interference)
+        try:
+            reply = fn(index)
+        except Exception as e:  # noqa: BLE001 — degrade, never fail the cycle
+            if not self._recommender_down:
+                log.warning(
+                    "recommender degraded (%s: %s); scoring without its "
+                    "signal", type(e).__name__, e)
+                self._recommender_down = True
+            if self._m_degraded is not None:
+                self._m_degraded.inc(client="recommender")
+            return {}
+        if self._recommender_down:
+            log.info("recommender recovered; full scoring resumed")
+            self._recommender_down = False
+        return reply
+
     def _slo_score(
         self,
         info: NodeInfo,
@@ -581,10 +623,8 @@ class TPUPlugin(
 
         best_score, best_part = float(MIN_NODE_SCORE), None
         best_duty = float("inf")
-        incoming_conf = self.recommender.impute_configurations(pod.metadata.name)
-        incoming_intf = self.recommender.impute_interference(
-            f"{pod.metadata.name}_{gen}"
-        )
+        incoming_conf = self._impute("conf", pod.metadata.name)
+        incoming_intf = self._impute("intf", f"{pod.metadata.name}_{gen}")
         # Hoist per-resident-pod predictions out of the partition loop —
         # conf_index and gen are loop-invariant, so with the real gRPC
         # recommender this is 2 roundtrips per resident pod instead of
@@ -595,8 +635,8 @@ class TPUPlugin(
             for other_name in names:
                 if other_name not in pred_cache:
                     pred_cache[other_name] = (
-                        self.recommender.impute_configurations(other_name).get(conf_index),
-                        self.recommender.impute_interference(f"{other_name}_{gen}"),
+                        self._impute("conf", other_name).get(conf_index),
+                        self._impute("intf", f"{other_name}_{gen}"),
                     )
         for part in partitions:
             if len(part.chip_ids) < chips_wanted:
@@ -719,7 +759,7 @@ class TPUPlugin(
             return min(eligible, key=lambda e: e[2])[0]
         best_cfg, best_pred = "", -1.0
         for cfg, parts, _ in eligible:
-            preds = self.recommender.impute_configurations(cfg)
+            preds = self._impute("conf", cfg)
             pred = preds.get(f"{parts}P_{gen}")
             if pred is None:
                 continue

@@ -8,13 +8,24 @@ short TTL: predictions only move on the server's retrain cadence (30 s
 md5 watch, server.py), so scoring many nodes against the same resident
 pods within a cycle — or across back-to-back cycles — repeats identical
 queries. The reference pays the full quadratic RPC cost every cycle
-(gpu_plugins.go:577-590)."""
+(gpu_plugins.go:577-590).
+
+Failure handling (the robustness PR): each RPC retries transient gRPC
+failures under a bounded ``RetryPolicy`` (utils/retry.py — attempt cap,
+jittered exponential backoff, wall-clock deadline), then raises to the
+caller; the TPU plugin's Score path catches that, counts it, and scores
+WITHOUT the recommender signal for the cycle (degraded scoring) instead
+of failing the pod. ``on_retry`` feeds
+``tpu_sched_rpc_retries_total{client="recommender"}`` and
+``fault_injector`` exposes the ``recommender.call`` hook to the chaos
+harness (testing/faults.py)."""
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
+from ..utils.retry import RetryPolicy, retry_call
 from .wire import (
     METHOD_CONFIGURATIONS,
     METHOD_INTERFERENCE,
@@ -25,11 +36,23 @@ from .wire import (
 
 class Client:
     def __init__(self, host: str = "127.0.0.1", port: int = 32700,
-                 timeout_s: float = 2.0, cache_ttl_s: float = 5.0):
+                 timeout_s: float = 2.0, cache_ttl_s: float = 5.0,
+                 retry: Optional[RetryPolicy] = None,
+                 on_retry: Optional[Callable[[], None]] = None,
+                 fault_injector=None):
         import grpc
 
         self._timeout = timeout_s
         self._ttl = cache_ttl_s
+        # Bounded: 3 tries, ~20/40 ms jittered backoff, whole-call
+        # deadline — the Score hot loop makes 2 calls per resident pod,
+        # so a dead recommender must cost milliseconds-bounded failures
+        # the plugin can degrade around, never a hang per call.
+        self._retry = retry or RetryPolicy(attempts=3, base_s=0.02,
+                                           max_s=0.2, deadline_s=1.5)
+        self.on_retry = on_retry
+        self._faults = fault_injector
+        self._retryable: tuple = (grpc.RpcError,)
         # (method, index) -> (expiry, reply dict). Errors are never cached
         # (a transient server outage must not pin failures for a TTL).
         self._cache: Dict[Tuple[str, str], Tuple[float, Dict[str, float]]] = {}
@@ -57,7 +80,7 @@ class Client:
                     # cached object would let one caller's mutation poison
                     # every later hit.
                     return dict(hit[1])
-        result, columns = call(index, timeout=self._timeout)
+        result, columns = self._call_bounded(call, index)
         reply = dict(zip(columns, result))
         if self._ttl > 0:
             with self._mu:
@@ -65,6 +88,29 @@ class Client:
                     self._cache.clear()
                 self._cache[key] = (now + self._ttl, reply)
         return reply
+
+    def _call_bounded(self, call, index: str):
+        """One RPC under the bounded-retry policy: transient gRPC
+        failures (server restarting, connection reset) and injected
+        chaos faults retry with jittered backoff until the attempt or
+        deadline bound, then raise to the caller — who degrades (the
+        plugin scores without the signal) rather than hangs."""
+        from ..testing.faults import InjectedFault
+
+        def attempt():
+            if self._faults is not None:
+                self._faults.fire("recommender.call")
+            return call(index, timeout=self._timeout)
+
+        on_retry = self.on_retry
+
+        def count(_attempt, _exc):
+            if on_retry is not None:
+                on_retry()
+
+        return retry_call(attempt, self._retry,
+                          retry_on=self._retryable + (InjectedFault,),
+                          on_retry=count)
 
     def impute_configurations(self, index: str) -> Dict[str, float]:
         return self._cached("conf", index, self._conf)

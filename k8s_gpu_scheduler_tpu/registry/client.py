@@ -6,12 +6,30 @@ Pure-stdlib socket client: no redis-py dependency, works against kvstored or
 a real Redis. Thread safety: one lock per client serializes request/response
 pairs (the reference creates a fresh go-redis client per call instead —
 gpu_plugins.go:534; pooling here avoids that per-call dial).
+
+Failure handling (the robustness PR): every transport failure retries
+under a bounded ``RetryPolicy`` (utils/retry.py — attempt cap,
+exponential backoff with jitter, wall-clock deadline), with the
+idempotency distinction preserved: a CONNECT failure is always safe to
+retry (nothing was sent), a command that died MID-FLIGHT re-sends only
+if it is in ``_IDEMPOTENT``. Backoff sleeps happen with the client lock
+RELEASED — sleeping under the lock would stall every other thread's
+call for the whole backoff ladder (graftcheck retry-lint's
+``blocking-io-under-lock`` rule). ``on_retry`` is the metrics hook the
+scheduler entrypoint maps onto
+``tpu_sched_rpc_retries_total{client="registry"}``, and
+``fault_injector`` (testing/faults.py) exposes the two failure points —
+``registry.connect`` and ``registry.roundtrip`` — to the chaos
+harness.
 """
 from __future__ import annotations
 
 import socket
 import threading
-from typing import List, Optional
+import time
+from typing import Callable, List, Optional
+
+from ..utils.retry import RetryPolicy
 
 
 class RegistryError(Exception):
@@ -42,12 +60,22 @@ class Client:
         password: Optional[str] = None,
         db: int = 0,
         timeout_s: float = 5.0,
+        retry: Optional[RetryPolicy] = None,
+        on_retry: Optional[Callable[[], None]] = None,
+        fault_injector=None,
     ) -> None:
         self.host = host
         self.port = port
         self._password = password
         self._db = db
         self._timeout = timeout_s
+        # Default bound: 4 tries, ~20/40/80 ms jittered backoff, and the
+        # whole call (sleeps included) never past 2 s — a dead registry
+        # costs a scheduler cycle a bounded, predictable delay, not a hang.
+        self._retry = retry or RetryPolicy(attempts=4, base_s=0.02,
+                                           max_s=0.25, deadline_s=2.0)
+        self.on_retry = on_retry
+        self._faults = fault_injector
         self._mu = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._buf = b""
@@ -72,13 +100,16 @@ class Client:
             if reply != "OK":
                 raise RegistryError(f"SELECT failed: {reply}")
 
+    def _close_locked(self) -> None:
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        finally:
+            self._sock = None
+
     def close(self) -> None:
         with self._mu:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                finally:
-                    self._sock = None
+            self._close_locked()
 
     def __enter__(self) -> "Client":
         return self
@@ -141,26 +172,61 @@ class Client:
         return self._read_reply_locked()
 
     def _call(self, *argv: str):
-        with self._mu:
-            if self._sock is None:
-                self._connect_locked()
+        """One command under the bounded-retry policy. Two failure
+        phases with different retry rights: a CONNECT-phase failure
+        (dial, AUTH/SELECT transport) sent nothing, so ANY command
+        retries it; a mid-flight failure (the server may have executed
+        the command and the reply died) re-sends only idempotent
+        commands — DEL stays absent from ``_IDEMPOTENT`` on purpose: a
+        blind re-send after a dropped reply would erase the key a second
+        time and report 0, lying to the caller about whether the key
+        existed. A server -ERR reply never lands here (the server DID
+        answer); AUTH failures abort immediately — retrying a bad
+        password is a lockout, not a recovery."""
+        policy = self._retry
+        deadline = policy.deadline_from(time.monotonic())
+        attempt = 0
+        while True:
+            sent = False
             try:
-                return self._roundtrip_locked(list(argv))
+                with self._mu:
+                    try:
+                        if self._sock is None:
+                            if self._faults is not None:
+                                self._faults.fire("registry.connect",
+                                                  drop_exc=ConnectionLost)
+                            self._connect_locked()
+                        sent = True
+                        if self._faults is not None:
+                            self._faults.fire("registry.roundtrip",
+                                              drop_exc=ConnectionLost)
+                        return self._roundtrip_locked(list(argv))
+                    except (OSError, ConnectionLost):
+                        # Transport died (server restarted, idle timeout,
+                        # injected drop): the socket is poisoned either
+                        # way — drop it so the next attempt redials.
+                        self._close_locked()
+                        raise
+            except AuthError:
+                raise
             except (OSError, ConnectionLost) as transport_err:
-                # Transport died (server restarted, idle timeout). Drop the
-                # socket; transparently retry only idempotent commands —
-                # a -ERR reply never lands here (the server DID answer).
-                try:
-                    if self._sock is not None:
-                        self._sock.close()
-                finally:
-                    self._sock = None
-                if argv[0].upper() not in _IDEMPOTENT:
+                if sent and argv[0].upper() not in _IDEMPOTENT:
                     raise ConnectionLost(
                         f"{argv[0]} failed mid-flight (not retried)"
                     ) from transport_err
-                self._connect_locked()
-                return self._roundtrip_locked(list(argv))
+                attempt += 1
+                delay = policy.backoff_s(attempt)
+                if policy.give_up(attempt, time.monotonic(), deadline,
+                                  delay):
+                    raise ConnectionLost(
+                        f"{argv[0]} failed after {attempt} attempt(s): "
+                        f"{transport_err}") from transport_err
+                if self.on_retry is not None:
+                    self.on_retry()
+                # Backoff with the lock RELEASED: other threads' calls
+                # proceed (and may themselves reconnect) while this one
+                # waits out its jittered delay.
+                time.sleep(delay)
 
     # -- API parity with client.go:26-67 ----------------------------------
     def set(self, key: str, value: str) -> None:

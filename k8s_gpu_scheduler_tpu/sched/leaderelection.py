@@ -48,6 +48,7 @@ class LeaderElector:
         lease_duration_s: float = 15.0,
         renew_period_s: Optional[float] = None,
         retry_period_s: Optional[float] = None,
+        renew_deadline_s: Optional[float] = None,
         on_started_leading: Optional[Callable[[], None]] = None,
         on_stopped_leading: Optional[Callable[[], None]] = None,
         clock: Callable[[], float] = time.time,
@@ -59,6 +60,21 @@ class LeaderElector:
         self.lease_duration_s = lease_duration_s
         self.renew_period_s = renew_period_s or lease_duration_s / 3.0
         self.retry_period_s = retry_period_s or lease_duration_s / 5.0
+        # client-go's renewDeadline: the holder considers itself demoted
+        # STRICTLY BEFORE a challenger can steal (which needs the full
+        # lease_duration past the last server renew). The margin is what
+        # lets an in-flight scheduling cycle on the old leader finish
+        # before the new leader's term starts — with a single threshold,
+        # demotion and steal are simultaneous and the terms can overlap
+        # (found by the chaos failover test).
+        self.renew_deadline_s = renew_deadline_s or 0.8 * lease_duration_s
+        if self.renew_deadline_s >= lease_duration_s:
+            # client-go errors on this exact misconfiguration: a deadline
+            # at or past the lease duration voids the margin and reopens
+            # the double-leadership window the margin exists to close.
+            raise ValueError(
+                f"renew_deadline_s ({self.renew_deadline_s}) must be < "
+                f"lease_duration_s ({lease_duration_s})")
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self.clock = clock
@@ -69,10 +85,11 @@ class LeaderElector:
 
     # -- public ------------------------------------------------------------
     def is_leader(self) -> bool:
-        """Leading AND the last successful renew is fresh — a partitioned
-        leader demotes itself before anyone can steal the lease."""
+        """Leading AND the last successful renew is inside the renew
+        deadline — a partitioned leader demotes itself strictly before
+        anyone can steal the lease (steal needs the FULL duration)."""
         return (self._leading.is_set()
-                and self.clock() - self._last_renew < self.lease_duration_s)
+                and self.clock() - self._last_renew < self.renew_deadline_s)
 
     def start(self) -> None:
         if self._thread is not None:
@@ -122,7 +139,7 @@ class LeaderElector:
                 self._stop.wait(self.renew_period_s)
             else:
                 was = self._leading.is_set()
-                if was and self.clock() - self._last_renew >= self.lease_duration_s:
+                if was and self.clock() - self._last_renew >= self.renew_deadline_s:
                     self._demote()
                 self._stop.wait(self.retry_period_s)
 
@@ -139,22 +156,36 @@ class LeaderElector:
         try:
             lease = self.server.get("Lease", self.name, self.namespace)
         except NotFound:
-            try:
-                self.server.create(Lease(
-                    metadata=ObjectMeta(name=self.name,
-                                        namespace=self.namespace),
-                    holder_identity=self.identity,
-                    lease_duration_s=self.lease_duration_s,
-                    acquire_time=now, renew_time=now, lease_transitions=0,
-                ))
-                self._last_renew = now
-                return True
-            except AlreadyExists:
-                return False
-            except Exception as e:  # noqa: BLE001
-                log.warning("lease create failed: %s", e)
-                return False
+            return self._create_fresh(now)
+        except Exception as e:  # noqa: BLE001 — transport flap, not fatal
+            # A dropped connection to the lease store must NOT kill the
+            # elector thread (found by the chaos harness: an injected
+            # registry flap permanently disabled election for the
+            # replica). Treat it like any failed renew: the retry loop
+            # keeps trying, and a holder that stays partitioned demotes
+            # itself via the staleness check in _run/is_leader.
+            log.warning("lease read failed: %s", e)
+            return False
+        return self._renew_or_steal(lease, now)
 
+    def _create_fresh(self, now: float) -> bool:
+        try:
+            self.server.create(Lease(
+                metadata=ObjectMeta(name=self.name,
+                                    namespace=self.namespace),
+                holder_identity=self.identity,
+                lease_duration_s=self.lease_duration_s,
+                acquire_time=now, renew_time=now, lease_transitions=0,
+            ))
+            self._last_renew = now
+            return True
+        except AlreadyExists:
+            return False
+        except Exception as e:  # noqa: BLE001
+            log.warning("lease create failed: %s", e)
+            return False
+
+    def _renew_or_steal(self, lease, now: float) -> bool:
         if lease.holder_identity == self.identity:
             lease.renew_time = now
             lease.lease_duration_s = self.lease_duration_s

@@ -65,8 +65,15 @@ class Scheduler:
         config: Optional[SchedulerConfig] = None,
         metrics: Optional[Registry] = None,
         elector=None,
+        fault_injector=None,
     ) -> None:
         self.config = config or SchedulerConfig()
+        # Chaos harness hook (testing/faults.py): ``sched.cycle`` fires at
+        # the top of every scheduling cycle — an injected drop unwinds the
+        # cycle exactly like any plugin failure (the pod requeues with
+        # backoff), which is the contract chaos tests verify. None in
+        # production: one `is None` check per cycle.
+        self._faults = fault_injector
         # Exported metrics — the BASELINE north-star (p50 schedule latency)
         # reads tpu_sched_e2e_duration_seconds; the reference exports nothing
         # of its own (SURVEY.md §5 "Metrics / observability").
@@ -233,6 +240,14 @@ class Scheduler:
             pod = self.queue.pop(timeout=0.5)
             if pod is None:
                 continue
+            if self.elector is not None and not self.elector.is_leader():
+                # Leadership lapsed while blocked in pop (the pop window
+                # straddles a demotion — found by the chaos failover
+                # test): the new leader owns this pod now. Requeue it
+                # locally with backoff so a re-elected replica still has
+                # it; never run a cycle without the lease.
+                self.queue.add_unschedulable(pod)
+                continue
             try:
                 self.schedule_pod(pod)
             except Exception:  # noqa: BLE001 — the cycle must survive anything
@@ -249,6 +264,8 @@ class Scheduler:
             return
         pod = live
 
+        if self._faults is not None:
+            self._faults.fire("sched.cycle")
         state = CycleState()
         state.write("cycle_start", time.perf_counter())
         try:

@@ -1,0 +1,18 @@
+"""Deterministic fault injection for chaos tests and the chaos bench leg.
+
+Importable from production code (the hook points in the serving engine,
+the control-plane clients and the scheduler cycle are ``if injector is
+not None`` guards), but nothing here runs unless a test or bench wires
+an injector in.
+"""
+from .faults import (
+    FaultInjector, FaultProxy, FaultRule, InjectedFault, Preempted,
+)
+
+__all__ = [
+    "FaultInjector",
+    "FaultProxy",
+    "FaultRule",
+    "InjectedFault",
+    "Preempted",
+]

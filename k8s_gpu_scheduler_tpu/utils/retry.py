@@ -1,0 +1,100 @@
+"""Bounded retry with exponential backoff, jitter and deadlines.
+
+The control-plane clients (registry/client.py, recommender/client.py)
+talk to services that flap under exactly the conditions this scheduler
+exists for — spot preemption, node churn, rolling restarts. The failure
+mode this module prevents is the one graftcheck's retry-lint flags: an
+unbounded ``while True: try/except/continue`` loop that turns a dead
+dependency into a hung scheduler thread. Every retry here is bounded
+THREE ways — attempt count, per-attempt backoff cap, and a wall-clock
+deadline — and backoff is jittered so a fleet of clients whose server
+just restarted doesn't reconnect in lockstep (the thundering-herd
+argument from the Google SRE book, the same reason client-go's
+wait.Backoff carries a Jitter factor).
+
+``RetryPolicy`` is data, not behavior: callers own their retry loop
+(the registry client's is idempotency-aware — a command that died
+mid-flight must NOT blindly re-send), and ``retry_call`` is the plain
+wrapper for callers without such constraints (the recommender client).
+"""
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple, Type
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded-retry shape: up to ``attempts`` tries in total, sleeping
+    ``base_s * multiplier**(attempt-1)`` (capped at ``max_s``, jittered
+    ±``jitter`` fraction) between them, never past ``deadline_s`` of
+    wall clock from the first attempt. ``attempts=1`` means no retry."""
+
+    attempts: int = 4
+    base_s: float = 0.02
+    multiplier: float = 2.0
+    max_s: float = 1.0
+    jitter: float = 0.5
+    deadline_s: float = float("inf")
+
+    def __post_init__(self) -> None:
+        if self.attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {self.attempts}")
+        if not 0.0 <= self.jitter <= 1.0:
+            raise ValueError(f"jitter must be in [0, 1], got {self.jitter}")
+
+    def backoff_s(self, attempt: int,
+                  rng: Optional[random.Random] = None) -> float:
+        """Sleep before retry number ``attempt`` (1-based: the sleep
+        between the first failure and the second try is attempt 1)."""
+        if attempt < 1:
+            raise ValueError(f"attempt must be >= 1, got {attempt}")
+        delay = min(self.base_s * self.multiplier ** (attempt - 1),
+                    self.max_s)
+        if self.jitter:
+            u = (rng.random() if rng is not None else random.random())
+            delay *= 1.0 + self.jitter * (2.0 * u - 1.0)
+        return max(delay, 0.0)
+
+    def deadline_from(self, now: float) -> float:
+        return now + self.deadline_s
+
+    def give_up(self, attempt: int, now: float, deadline: float,
+                next_delay_s: float = 0.0) -> bool:
+        """True when retry number ``attempt`` must NOT happen: the
+        attempt bound is spent, or sleeping ``next_delay_s`` would land
+        past the deadline (waking up only to time out is worse than
+        failing now — the caller gets its error while there is still
+        deadline budget to act on it)."""
+        return attempt >= self.attempts or now + next_delay_s >= deadline
+
+
+def retry_call(
+    fn: Callable,
+    policy: RetryPolicy,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    rng: Optional[random.Random] = None,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Call ``fn()`` under ``policy``: retry on ``retry_on`` exceptions
+    with jittered exponential backoff until the attempt bound or the
+    deadline is spent, then re-raise the LAST failure. ``on_retry`` is
+    invoked once per retry (after the failure, before the sleep) — the
+    metrics hook behind ``tpu_sched_rpc_retries_total``."""
+    deadline = policy.deadline_from(clock())
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            attempt += 1
+            delay = policy.backoff_s(attempt, rng=rng)
+            if policy.give_up(attempt, clock(), deadline, delay):
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(delay)

@@ -1,0 +1,47 @@
+"""Seeded-bad fixture: retry-lint true positives.
+
+Reintroducing this file into the scanned tree must fail
+``python -m k8s_gpu_scheduler_tpu.analysis`` (and ``--fast``): it
+carries one violation per retry-lint rule — the unbounded
+``while True: try/except/continue`` reconnect loop that turns a dead
+control-plane dependency into a hung scheduler thread, and a backoff
+sleep taken while holding the client lock, stalling every other
+thread's call for the whole backoff ladder. tests/test_analysis.py
+asserts each specific rule fires; the production shape both rules
+demand lives in utils/retry.py + registry/client.py.
+"""
+import socket
+import threading
+import time
+
+
+class StubbornClient:
+    """Retries forever and naps under its lock — both anti-patterns."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self._mu = threading.Lock()
+        self._host = host
+        self._port = port
+        self._sock = None
+
+    def call_forever(self, payload: bytes) -> bytes:
+        while True:                       # no attempt bound, no deadline
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        (self._host, self._port))
+                self._sock.sendall(payload)
+                return self._sock.recv(4096)
+            except OSError:               # swallowed: the failure path
+                self._sock = None         # never exits this loop
+                time.sleep(0.1)
+
+    def call_napping_under_lock(self, payload: bytes) -> bytes:
+        with self._mu:
+            for _ in range(3):
+                try:
+                    self._sock.sendall(payload)
+                    return self._sock.recv(4096)
+                except OSError:
+                    time.sleep(0.5)       # backoff with the lock HELD
+            raise ConnectionError("gave up")

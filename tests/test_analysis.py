@@ -76,6 +76,43 @@ class TestAstLint:
         assert {"lock-guard", "tracer-cast", "host-time-in-trace",
                 "bare-except"} <= rules
 
+    def test_retry_lint_rules_fire(self):
+        """The retry-lint fixture: the unbounded reconnect loop and the
+        lock-held backoff sleep must BOTH fire (and the fast CLI test
+        below proves reintroducing the file fails the gate)."""
+        findings = lint_source(
+            os.path.join(FIXTURES, "bad_retry.py"),
+            open(os.path.join(FIXTURES, "bad_retry.py")).read())
+        rules = rules_of(findings)
+        assert {"unbounded-retry", "blocking-io-under-lock"} <= rules
+
+    def test_bounded_retry_is_clean(self):
+        """A loop whose failure path re-raises at the bound (the
+        registry client's shape) must NOT flag, and neither must a
+        Condition.wait under its lock."""
+        src = textwrap.dedent("""
+            import threading, time
+            class Bounded:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self._cv = threading.Condition(self._mu)
+                def call(self, op, policy):
+                    attempt = 0
+                    while True:
+                        try:
+                            return op()
+                        except OSError:
+                            attempt += 1
+                            if attempt >= policy.attempts:
+                                raise
+                            time.sleep(policy.backoff_s(attempt))
+                def wait_ready(self):
+                    with self._mu:
+                        self._cv.wait(1.0)
+        """)
+        assert not {"unbounded-retry", "blocking-io-under-lock"} \
+            & rules_of(lint_source("<t>", src))
+
     def test_numpy_in_trace(self):
         src = textwrap.dedent("""
             import numpy as np
@@ -636,7 +673,7 @@ class TestCli:
         assert proc.returncode == 0, proc.stderr
 
     def test_reintroduced_fast_fixtures_fail(self):
-        for fixture in ("bad_astlint.py", "bad_vmem.py",
+        for fixture in ("bad_astlint.py", "bad_retry.py", "bad_vmem.py",
                         "bad_vmem_paged.py", "bad_vmem_verify.py"):
             proc = run_cli(os.path.join(FIXTURES, fixture))
             assert proc.returncode == 1, (fixture, proc.stderr)
@@ -645,16 +682,16 @@ class TestCli:
     @pytest.mark.slow   # ~1 min of traced-pass subprocess; the fast-pass
     # fixture test above keeps per-family CLI signal in tier-1, and the
     # unfiltered CI suite runs this end-to-end check.
-    def test_full_cli_catches_all_five_fixture_families(self):
-        """The acceptance criterion end-to-end: the DEFAULT five-pass CLI
+    def test_full_cli_catches_all_six_fixture_families(self):
+        """The acceptance criterion end-to-end: the DEFAULT six-pass CLI
         exits non-zero with file:line findings when the seeded bad
         fixtures are in the scanned paths (one subprocess run for all
-        five — the traced passes dominate its ~15 s)."""
+        six — the traced passes dominate its ~15 s)."""
         proc = run_cli(FIXTURES, "--json", fast=False)
         assert proc.returncode == 1, proc.stderr
         import json as _json
 
         summary = _json.loads(proc.stdout.strip().splitlines()[-1])
         assert {"lock-guard", "vmem-budget", "captured-const",
-                "steady-state-retrace", "shared-page-write"} \
-            <= set(summary["rules"])
+                "steady-state-retrace", "shared-page-write",
+                "unbounded-retry"} <= set(summary["rules"])

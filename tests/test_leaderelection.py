@@ -140,6 +140,103 @@ class TestSchedulerHA:
             s2.stop()
 
 
+class TestChaosFailover:
+    """Failover under INJECTED registry/lease flaps (the chaos harness,
+    testing/faults.py): the leader's lease transport dies mid-cycle, it
+    demotes itself, the standby takes over — and no pod is ever
+    scheduled twice."""
+
+    def test_leader_flap_hands_over_without_double_leadership(self):
+        from k8s_gpu_scheduler_tpu.testing.faults import (
+            FaultInjector, FaultProxy, FaultRule,
+        )
+
+        server = APIServer()
+        inj = FaultInjector(rules=[
+            # From its 8th lease op on, every op of the LEADER's client
+            # drops — the partitioned-leader scenario. The standby's
+            # client is not proxied and keeps working.
+            FaultRule(site="lease", kind="drop", after=7, every=1),
+        ])
+        a = mk_elector(FaultProxy(server, inj, "lease"), "a")
+        b = mk_elector(server, "b")
+        a.start()
+        assert a.wait_until_leader(3)
+        b.start()
+        try:
+            # The flap starts; a demotes itself (its clock) BEFORE b can
+            # steal — sample continuously for any double-leadership
+            # window (client-go's non-overlap argument).
+            deadline = time.time() + 5
+            overlap = False
+            while time.time() < deadline and not b.is_leader():
+                overlap |= a.is_leader() and b.is_leader()
+                time.sleep(0.01)
+            assert b.is_leader(), "standby never took over"
+            assert not a.is_leader()
+            assert not overlap
+            assert inj.log, "no faults fired — the scenario tested nothing"
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_no_pod_scheduled_twice_through_failover(self):
+        """Scheduler integration: the leader loses its lease session
+        mid-run, the standby takes over and schedules the NEXT pod; the
+        attempts counters prove each pod was bound exactly once."""
+        from k8s_gpu_scheduler_tpu.config import SchedulerConfig
+        from k8s_gpu_scheduler_tpu.testing.faults import (
+            FaultInjector, FaultProxy, FaultRule,
+        )
+
+        server = APIServer()
+        server.create(mk_node("n1", chips=8))
+        inj = FaultInjector(rules=[
+            FaultRule(site="lease", kind="drop", after=7, every=1),
+        ])
+        cfg = SchedulerConfig(backoff_initial_s=0.05, backoff_max_s=0.2)
+
+        def mk_sched(ident, elector_server):
+            sched = Scheduler(server, profile=Profile(), config=cfg,
+                              elector=mk_elector(elector_server, ident))
+            tpu = TPUPlugin(sched.handle, registry=FakeRegistry())
+            sched.profile = Profile(pre_filter=[tpu], filter=[tpu],
+                                    score=[tpu], reserve=[tpu],
+                                    post_bind=[tpu])
+            return sched
+
+        s1 = mk_sched("replica-1", FaultProxy(server, inj, "lease"))
+        s2 = mk_sched("replica-2", server)
+        s1.start()
+        assert s1.elector.wait_until_leader(3)
+        s2.start()
+        try:
+            server.create(ConfigMap(metadata=ObjectMeta(name="cm1"),
+                                    data={}))
+            server.create(mk_pod("p1", chips=2, cm="cm1"))
+            assert wait_until(
+                lambda: server.get("Pod", "p1", "default").spec.node_name,
+                timeout=5)
+            # The flap (already scheduled by rule) partitions replica-1
+            # from the lease; replica-2 steals after expiry.
+            assert wait_until(s2.elector.is_leader, timeout=5)
+            server.create(ConfigMap(metadata=ObjectMeta(name="cm2"),
+                                    data={}))
+            server.create(mk_pod("p2", chips=2, cm="cm2"))
+            assert wait_until(
+                lambda: server.get("Pod", "p2", "default").spec.node_name,
+                timeout=5)
+            c1 = s1.metrics.counter("tpu_sched_attempts_total")
+            c2 = s2.metrics.counter("tpu_sched_attempts_total")
+            # Exactly one bind per pod across BOTH replicas.
+            assert c1.value(result="scheduled") \
+                + c2.value(result="scheduled") == 2
+            assert c2.value(result="scheduled") >= 1
+        finally:
+            s1.stop()
+            s2.stop()
+
+
 class TestLeaseOverREST:
     def test_lease_cas_roundtrip(self):
         """Lease CRUD + compare-and-swap through the REST adapter: PUT with

@@ -276,6 +276,85 @@ class TestServingPoolExport:
         assert "# HELP tpu_serve_spec_accept_rate" in text
         assert set(snapshot) <= set(SERVING_POOL_GAUGES)
 
+    def test_lifecycle_gauges_exported(self):
+        """The robustness gauges (drain/restore/resume/watchdog/error
+        isolation) ride the same map: names match the PR contract
+        (tpu_serve_drain_duration_seconds, ...)."""
+        from k8s_gpu_scheduler_tpu.metrics import (
+            SERVING_POOL_GAUGES, export_serving_pool,
+        )
+
+        reg = Registry()
+        snapshot = {
+            "drain_duration_seconds": 0.012,
+            "restore_duration_seconds": 0.034,
+            "requests_resumed_total": 5.0,
+            "request_errors_total": 1.0,
+            "last_step_age_seconds": 0.25,
+        }
+        export_serving_pool(reg, snapshot)
+        text = reg.expose()
+        assert "tpu_serve_drain_duration_seconds 0.012" in text
+        assert "tpu_serve_restore_duration_seconds 0.034" in text
+        assert "tpu_serve_requests_resumed_total 5.0" in text
+        assert "tpu_serve_request_errors_total 1.0" in text
+        assert "tpu_serve_last_step_age_seconds 0.25" in text
+        assert "# HELP tpu_serve_last_step_age_seconds" in text
+        assert set(snapshot) <= set(SERVING_POOL_GAUGES)
+
+    def test_rpc_retry_counter_labels(self):
+        """tpu_sched_rpc_retries_total{client=...}: the per-client retry
+        counter the scheduler entrypoint wires into both control-plane
+        clients' on_retry hooks (cmd/scheduler.py)."""
+        reg = Registry()
+        c = reg.counter("tpu_sched_rpc_retries_total",
+                        "Bounded control-plane RPC retries, by client")
+        c.inc(client="registry")
+        c.inc(client="registry")
+        c.inc(client="recommender")
+        assert c.value(client="registry") == 2
+        assert c.value(client="recommender") == 1
+        text = reg.expose()
+        assert 'tpu_sched_rpc_retries_total{client="registry"} 2' in text
+        assert 'tpu_sched_rpc_retries_total{client="recommender"} 1' \
+            in text
+
+    def test_live_drained_engine_exports_lifecycle_gauges(self):
+        """End to end: a drained+restored paged engine's pool_metrics()
+        carries the lifecycle gauges and the exporter publishes them."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from k8s_gpu_scheduler_tpu.metrics import export_serving_pool
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+
+        def engine():
+            return ContinuousBatcher(
+                params, cfg, n_slots=2, max_len=64, chunk=4,
+                prefill_bucket=8, kv_layout="paged", page_size=8)
+
+        eng = engine()
+        eng.submit(list(range(1, 12)), max_new=6)
+        eng.step()
+        snap = eng.drain()
+        fresh = engine()
+        fresh.restore(snap)
+        m = fresh.pool_metrics()
+        assert m["requests_resumed_total"] == 1.0
+        assert m["restore_duration_seconds"] > 0
+        assert m["last_step_age_seconds"] >= 0
+        reg = Registry()
+        export_serving_pool(reg, m)
+        text = reg.expose()
+        assert "tpu_serve_requests_resumed_total 1.0" in text
+        assert "tpu_serve_restore_duration_seconds" in text
+
     def test_live_spec_engine_snapshot_exports(self):
         """End to end against a real speculative paged engine: after a
         drained wave, pool_metrics() carries the spec gauges and the
