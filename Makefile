@@ -13,9 +13,10 @@ native:
 	$(MAKE) -C native/kvstore
 	$(MAKE) -C native/tpuprobe
 
-# graftcheck fast passes (AST lint + Pallas VMEM budgeter — no tracing;
-# the same gate tier-1 runs via tests/test_graftcheck_clean.py). The full
-# four-pass analyzer (jaxpr audit + recompile/donation guard) is
+# graftcheck fast passes (AST lint incl. retry-lint + trace-lint
+# [trace-in-jit], Pallas VMEM budgeter — no tracing; the same gate tier-1
+# runs via tests/test_graftcheck_clean.py). The full seven-pass analyzer
+# (jaxpr audit + recompile/donation guard + alias audit) is
 # `$(PY) -m k8s_gpu_scheduler_tpu.analysis` with no flags.
 lint:
 	$(PY) -m k8s_gpu_scheduler_tpu.analysis --fast
@@ -39,6 +40,7 @@ bench-smoke:
 	$(PY) bench.py --leg prefix_cache --smoke
 	$(PY) bench.py --leg speculative --smoke
 	$(PY) bench.py --leg chaos --smoke
+	$(PY) bench.py --leg obs_overhead --smoke
 	$(PY) bench.py --leg decode_attention --smoke
 
 demo: native

@@ -12,7 +12,6 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 from typing import Optional
 
 from ..registry.inventory import (
@@ -38,6 +37,7 @@ class Publisher:
         interval_s: float = 2.0,
         heartbeat_s: float = 30.0,
         metrics_registry=None,
+        clock=None,
     ):
         """``metrics_registry``: optional metrics.exporter.Registry — every
         scrape also updates the node's own Prometheus gauges (the TPU_SERIES
@@ -46,8 +46,15 @@ class Publisher:
         (registry AND re-exporter) → Prometheus → scheduler's PromClient
         fallback. The reference depends on dcgm-exporter existing for this
         whole leg (prom_metrics.go:63-70)."""
+        from ..obs import SYSTEM_CLOCK
+
         self.registry = registry
         self.scraper = scraper or Scraper()
+        # Injected time source (obs.Clock): heartbeat STALENESS is a
+        # duration and rides monotonic; published_at stays wall time —
+        # it crosses processes (the reshaper compares it to its own wall
+        # clock).
+        self._clock = clock or SYSTEM_CLOCK
         self.metrics_registry = metrics_registry
         self.node_name = node_name or os.environ.get("NODE_NAME", "")
         if not self.node_name:
@@ -74,7 +81,7 @@ class Publisher:
             chips=chips,
             worker_id=self.worker_id,
             utilization=util,
-            published_at=time.time(),
+            published_at=self._clock.wall(),
         )
 
     def export_metrics(self, inv: NodeInventory) -> None:
@@ -103,7 +110,12 @@ class Publisher:
         # Change detection must ignore the timestamp (else every tick
         # "changes") — compare the payload with published_at zeroed.
         probe = NodeInventory(**{**inv.__dict__, "published_at": 0.0}).to_json()
-        stale = time.time() - self._last_publish >= self.heartbeat_s
+        # Monotonic staleness: on the old wall-clock math an NTP step
+        # backward silenced heartbeats for the step's width (dead-agent
+        # aging on the scheduler side would fire), a step forward forced
+        # a spurious publish — durations never ride the wall clock.
+        stale = (self._clock.monotonic() - self._last_publish
+                 >= self.heartbeat_s)
         if not force and not stale and probe == self._last_json:
             return False
         publish_inventory(self.registry, inv)
@@ -111,7 +123,7 @@ class Publisher:
             node_key(self.node_name) + HEARTBEAT_SUFFIX, str(inv.published_at)
         )
         self._last_json = probe
-        self._last_publish = time.time()
+        self._last_publish = self._clock.monotonic()
         return True
 
     # -- loop --------------------------------------------------------------

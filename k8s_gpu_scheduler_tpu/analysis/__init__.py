@@ -26,6 +26,11 @@ k8s_gpu_scheduler_tpu.analysis``; importable APIs below):
    path) and blocking sleeps/socket calls made while holding a lock —
    the two anti-patterns utils/retry.py's bounded ``RetryPolicy``
    replaces in the control-plane clients.
+7. **Trace-lint** (``tracelint``, runs inside the AST pass): the
+   ``trace-in-jit`` rule — obs/ span/tracer/flight-recorder calls inside
+   a jit-traced body are host syncs (at best trace-time constants that
+   replay a lie); tracing belongs on the host side of the dispatch, and
+   this pass keeps it there.
 
 Suppression: ``# graftcheck: ignore[rule]`` on the offending line, with a
 rationale in the surrounding comment (policy in README).
@@ -38,6 +43,7 @@ from .findings import ALL_RULES, Finding, Report, parse_suppressions
 from .alias import audit_shared_pages, check_shared_pages
 from .astlint import lint_source, run_astlint
 from .retrylint import lint_retry
+from .tracelint import lint_trace_calls
 from .vmem import (
     VMEM_BYTES_PER_CORE, audit_vmem, decode_attention_footprint,
     flash_attention_footprint, paged_decode_attention_footprint,
@@ -51,6 +57,7 @@ __all__ = [
     "parse_suppressions",
     "lint_source",
     "lint_retry",
+    "lint_trace_calls",
     "run_astlint",
     "VMEM_BYTES_PER_CORE",
     "audit_vmem",

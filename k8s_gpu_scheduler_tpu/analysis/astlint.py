@@ -417,6 +417,8 @@ def lint_source(path: str, source: str) -> List[Finding]:
         return [Finding("syntax-error", path, e.lineno or 0, str(e.msg))]
     from .retrylint import lint_retry
 
+    from .tracelint import lint_trace_calls
+
     scopes = _Scopes(tree)
     traced = _collect_traced(tree, scopes)
     np_aliases = _numpy_aliases(tree)
@@ -428,6 +430,8 @@ def lint_source(path: str, source: str) -> List[Finding]:
             label = getattr(node, "name", "<lambda>")
             findings.extend(
                 _lint_traced_body(path, node, np_aliases, label))
+            findings.extend(
+                lint_trace_calls(path, node, label, _walk_shallow))
         elif isinstance(node, ast.ClassDef):
             findings.extend(_lint_class_locks(path, node))
     findings.extend(_lint_module_wide(path, tree, traced))

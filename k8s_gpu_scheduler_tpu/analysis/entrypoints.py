@@ -230,6 +230,42 @@ def _paged_batcher_scenario() -> tuple:
     return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
 
 
+def _paged_traced_batcher_scenario() -> tuple:
+    """Tracing-on edition of the paged scenario: the obs tracer records a
+    span around every host-side dispatch, which must be INVISIBLE to the
+    compiled programs — same jit keys (spans never enter traced code:
+    the trace-in-jit lint enforces the boundary statically, this
+    scenario enforces it dynamically), zero retraces across waves, pool
+    + table still riding the donation chain."""
+    import dataclasses
+
+    from ..models.serving import ContinuousBatcher
+    from ..obs import Tracer
+
+    cfg, params = _tiny()
+    eng = ContinuousBatcher(params, dataclasses.replace(cfg,
+                                                        decode_attn="fused"),
+                            n_slots=2, max_len=32, chunk=2,
+                            prefill_bucket=8, kv_dtype="int8",
+                            kv_layout="paged", page_size=8,
+                            tracer=Tracer())
+    rng = np.random.default_rng(0)
+
+    def warmup():
+        eng.submit(rng.integers(0, cfg.vocab, 5), max_new=3)
+        eng.run()
+
+    def wave(plen: int):
+        def go():
+            eng.submit(rng.integers(0, cfg.vocab, plen), max_new=3)
+            eng.submit(rng.integers(0, cfg.vocab, plen - 1), max_new=2)
+            eng.run()
+        return go
+
+    steady = [wave(4), wave(6), wave(8)]
+    return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
+
+
 def _paged_prefix_batcher_scenario() -> tuple:
     """Prefix-cache edition of the paged scenario: every steady wave's
     admissions HIT the radix cache (a shared 8-token system prefix the
@@ -340,6 +376,8 @@ def recompile_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
     return [
         ("batcher_steady_decode", _batcher_scenario),
         ("batcher_steady_decode_paged", _paged_batcher_scenario),
+        ("batcher_steady_decode_paged_traced",
+         _paged_traced_batcher_scenario),
         ("batcher_steady_decode_paged_prefix", _paged_prefix_batcher_scenario),
         ("batcher_steady_decode_paged_spec", _paged_spec_batcher_scenario),
         ("generate_steady_state", _generate_scenario),

@@ -1,0 +1,97 @@
+"""Trace-lint — the ``trace-in-jit`` rule (graftcheck's seventh pass).
+
+The obs/ span API is host-side by contract: a ``tracer.span(...)`` /
+``tracer.record(...)`` / ``flight.record(...)`` call evaluated inside a
+jit-traced body is the same hazard class the host-sync lint already
+polices — at best it runs ONCE at trace time (a span that "measures" the
+compiled program forever replays the trace-time duration, i.e. lies),
+and any data-dependent attr forces a tracer concretization / host sync
+in the middle of the hot program. The right shape is always the one the
+serving engine uses: time the *dispatch* on the host, outside jit.
+
+Detection is syntactic, like the sibling rules, and runs inside the fast
+AST pass (``make lint``, tier-1's test_graftcheck_clean.py): inside a
+traced body (astlint's traced-function closure), flag
+
+- attribute calls whose receiver name mentions a tracing object
+  (``tracer``/``_tracer``/``trace``/``flight``/``obs``) and whose method
+  is part of the span-API surface (``span``/``record``/``event``), and
+- direct calls to functions named like span constructors
+  (``span``, ``trace_span``, ``start_span``).
+
+Receiver-name matching keeps the rule import-light (no type inference);
+the names are the obs/ API's own, so a false positive requires calling
+an unrelated ``.record()`` on something *named* a tracer inside jit —
+at which point the name is the bug. The seeded failing fixture is
+tests/data/graftcheck/bad_trace.py.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .findings import Finding
+
+# Method names of the obs tracing surface (Tracer.span/record/event,
+# FlightRecorder.record).
+_SPAN_METHODS = {"span", "record", "event"}
+# Receiver-name fragments that identify a tracing object.
+_TRACE_RECEIVERS = ("tracer", "trace", "flight", "obs")
+# Bare function names that construct spans.
+_SPAN_FUNCS = {"span", "trace_span", "start_span"}
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _receiver_label(node: ast.AST) -> str:
+    """Dotted-ish label of a call receiver: ``self._tracer`` ->
+    ``self._tracer``, ``tr`` -> ``tr``."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_trace_receiver(node: ast.AST) -> bool:
+    label = _receiver_label(node).lower()
+    leaf = label.rsplit(".", 1)[-1]
+    return any(frag in leaf for frag in _TRACE_RECEIVERS)
+
+
+def lint_trace_calls(path: str, fn: ast.AST, fn_label: str,
+                     walk_shallow) -> List[Finding]:
+    """Scan one TRACED function body (shallow — nested defs are their own
+    traced units, exactly like the sibling traced-body rules) for span
+    API calls. ``walk_shallow`` is astlint's traversal, passed in to keep
+    one definition of 'the body'."""
+    out: List[Finding] = []
+    for node in walk_shallow(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute):
+            method = node.func.attr
+            if method in _SPAN_METHODS and _is_trace_receiver(node.func.value):
+                out.append(Finding(
+                    "trace-in-jit", path, node.lineno,
+                    f"{_receiver_label(node.func)}() inside traced "
+                    f"function {fn_label}: span/tracing calls are host "
+                    f"syncs — at best they run once at trace time and "
+                    f"replay a constant; time the dispatch on the host, "
+                    f"outside jit"))
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id in _SPAN_FUNCS:
+            out.append(Finding(
+                "trace-in-jit", path, node.lineno,
+                f"{node.func.id}() inside traced function {fn_label}: "
+                f"span/tracing calls are host syncs — trace the host-side "
+                f"dispatch instead"))
+    return out

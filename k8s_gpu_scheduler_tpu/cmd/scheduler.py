@@ -228,8 +228,10 @@ def main(argv=None) -> int:
         if args.once:
             import time
 
-            deadline = time.time() + 60
-            while time.time() < deadline and not stop.is_set():
+            # Monotonic deadline: a 60s WAIT is a duration — the wall
+            # clock (NTP steps) must not stretch or collapse it.
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not stop.is_set():
                 pods = server.list("Pod")
                 if pods and all(p.spec.node_name for p in pods):
                     for p in pods:

@@ -15,8 +15,8 @@ from .client import (
     parse_response,
 )
 from .exporter import (
-    Counter, Gauge, Histogram, MetricsServer, Registry,
-    SERVING_POOL_GAUGES, export_serving_pool,
+    Counter, Gauge, Histogram, MetricsServer, PHASE_BUCKETS,
+    PHASE_HISTOGRAM, Registry, SERVING_POOL_GAUGES, export_serving_pool,
 )
 
 __all__ = [
@@ -34,6 +34,8 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsServer",
+    "PHASE_BUCKETS",
+    "PHASE_HISTOGRAM",
     "Registry",
     "SERVING_POOL_GAUGES",
     "export_serving_pool",

@@ -80,6 +80,19 @@ class Gauge:
         return lines
 
 
+class _HistState:
+    """Per-label-set histogram accumulator (bucket counts, sum, total,
+    bounded raw window)."""
+
+    __slots__ = ("counts", "sum", "total", "observations")
+
+    def __init__(self, n_buckets: int, window: int) -> None:
+        self.counts = [0] * (n_buckets + 1)  # +Inf bucket last
+        self.sum = 0.0
+        self.total = 0
+        self.observations: Deque[float] = deque(maxlen=window)
+
+
 class Histogram:
     # Raw observations kept for quantile() are bounded: a long-running
     # scheduler daemon observes every cycle, and an unbounded list would be
@@ -93,50 +106,79 @@ class Histogram:
         self.help = help_
         self.buckets = tuple(sorted(buckets))
         self._mu = threading.Lock()
-        self._counts = [0] * (len(self.buckets) + 1)  # +Inf bucket last
-        self._sum = 0.0
-        self._total = 0
-        self._observations: Deque[float] = deque(maxlen=self.MAX_RAW_OBSERVATIONS)
+        # Keyed by sorted label items; () is the unlabeled series, so the
+        # no-label API (the scheduler's cycle/e2e histograms) is unchanged
+        # while labeled series (tpu_serve_phase_duration_seconds{phase=})
+        # ride the same metric. The unlabeled series exists EAGERLY:
+        # a registered-but-unobserved histogram must keep exposing its
+        # zeroed _bucket/_sum/_count lines (pre-label behavior — alerting
+        # distinguishes "zero observations" from "metric absent").
+        self._states: Dict[Tuple[Tuple[str, str], ...], _HistState] = {
+            (): _HistState(len(self.buckets), self.MAX_RAW_OBSERVATIONS)}
 
-    def observe(self, value: float) -> None:
+    def _state_locked(self, key) -> _HistState:
+        st = self._states.get(key)
+        if st is None:
+            st = _HistState(len(self.buckets), self.MAX_RAW_OBSERVATIONS)
+            self._states[key] = st
+        return st
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
         with self._mu:
-            self._sum += value
-            self._total += 1
-            self._observations.append(value)
+            st = self._state_locked(key)
+            st.sum += value
+            st.total += 1
+            st.observations.append(value)
             for i, b in enumerate(self.buckets):
                 if value <= b:
-                    self._counts[i] += 1
+                    st.counts[i] += 1
                     return
-            self._counts[-1] += 1
+            st.counts[-1] += 1
 
     @property
     def count(self) -> int:
         with self._mu:
-            return self._total
+            return sum(st.total for st in self._states.values())
 
-    def quantile(self, q: float) -> Optional[float]:
-        """Exact quantile over the (bounded window of) raw observations —
-        bench convenience; real Prometheus would estimate from buckets."""
+    def count_for(self, **labels: str) -> int:
+        key = tuple(sorted(labels.items()))
         with self._mu:
-            if not self._observations:
+            st = self._states.get(key)
+            return st.total if st else 0
+
+    def quantile(self, q: float, **labels: str) -> Optional[float]:
+        """Exact quantile over the (bounded window of) raw observations —
+        bench convenience; real Prometheus would estimate from buckets.
+        With labels, the quantile of that one series; without, of the
+        unlabeled series (the pre-label behavior)."""
+        key = tuple(sorted(labels.items()))
+        with self._mu:
+            st = self._states.get(key)
+            if st is None or not st.observations:
                 return None
-            xs = sorted(self._observations)
+            xs = sorted(st.observations)
         idx = min(len(xs) - 1, max(0, int(q * len(xs))))
         return xs[idx]
 
     def expose(self) -> List[str]:
         with self._mu:
-            counts = list(self._counts)
-            total = self._total
-            s = self._sum
+            states = [(key, list(st.counts), st.total, st.sum)
+                      for key, st in sorted(self._states.items())]
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
-        cumulative = 0
-        for b, c in zip(self.buckets, counts):
-            cumulative += c
-            lines.append(f'{self.name}_bucket{{le="{b}"}} {cumulative}')
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
-        lines.append(f"{self.name}_sum {s}")
-        lines.append(f"{self.name}_count {total}")
+        for key, counts, total, s in states:
+            labels = dict(key)
+            cumulative = 0
+            for b, c in zip(self.buckets, counts):
+                cumulative += c
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels({**labels, 'le': str(b)})} {cumulative}")
+            lines.append(
+                f"{self.name}_bucket"
+                f"{_fmt_labels({**labels, 'le': '+Inf'})} {total}")
+            lines.append(f"{self.name}_sum{_fmt_labels(labels)} {s}")
+            lines.append(f"{self.name}_count{_fmt_labels(labels)} {total}")
         return lines
 
 
@@ -217,16 +259,42 @@ SERVING_POOL_GAUGES = {
 }
 
 
+# Per-phase request-lifecycle latency histogram (obs/ tracing): observed
+# from the phase durations ContinuousBatcher.pool_metrics() drains
+# atomically with the gauges above (one lock snapshot — a scrape can
+# never see a phase batch from one step next to a watchdog age from
+# another). Sub-millisecond lower buckets: admit/reap are host-side
+# bookkeeping phases far below the scheduler's cycle ladder.
+PHASE_HISTOGRAM = "tpu_serve_phase_duration_seconds"
+PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
 def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
                         prefix: str = "tpu_serve_") -> None:
     """Publish a ``ContinuousBatcher.pool_metrics()`` snapshot as gauges
     (``tpu_serve_page_utilization``, ``tpu_serve_prefix_hit_rate``, ...).
     Keys absent from the snapshot (contiguous layout → {}, prefix cache
     off → no prefix_* keys) are simply skipped, so callers can publish
-    unconditionally on every scrape/step."""
+    unconditionally on every scrape/step.
+
+    The ``phase_durations`` key (present when the engine has a tracer
+    attached) is a drained-once batch of ``(phase, seconds)`` pairs from
+    the same lock snapshot as the gauges; it folds into the
+    ``tpu_serve_phase_duration_seconds{phase=...}`` histogram rather
+    than a gauge — durations are a distribution, not a level."""
     for key, help_ in SERVING_POOL_GAUGES.items():
         if key in pool_metrics:
             registry.gauge(prefix + key, help_).set(pool_metrics[key])
+    phases = pool_metrics.get("phase_durations") or ()
+    if phases:
+        hist = registry.histogram(
+            PHASE_HISTOGRAM,
+            "Request-lifecycle phase durations (queue|admit|prefill|"
+            "decode_chunk|verify|rewind|reap), by phase",
+            buckets=PHASE_BUCKETS)
+        for phase, seconds in phases:
+            hist.observe(float(seconds), phase=str(phase))
 
 
 class MetricsServer:

@@ -158,10 +158,11 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
         print(f"resnet50 img/s={ips:.1f} loss={float(loss):.3f} slo={slo} "
               f"chips={os.environ.get('TPU_VISIBLE_CHIPS', '?')}", flush=True)
         # Feedback loop (recommender/collector.py), paced to ~1 Hz so a
-        # fast step can't hammer the registry.
-        if publish is not None and time.time() - last_pub >= 1.0:
+        # fast step can't hammer the registry. Monotonic pacing: a wall
+        # clock step must not silence (or burst) the publish cadence.
+        if publish is not None and time.monotonic() - last_pub >= 1.0:
             publish(ips)
-            last_pub = time.time()
+            last_pub = time.monotonic()
 
 
 if __name__ == "__main__":  # pragma: no cover

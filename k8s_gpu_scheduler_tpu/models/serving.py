@@ -51,7 +51,8 @@ the recommender's QPS predictions.
 """
 from __future__ import annotations
 
-import time
+import threading
+from collections import OrderedDict, deque
 from functools import partial
 from typing import Dict, Optional, Tuple
 
@@ -60,6 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..obs import FlightRecorder, SYSTEM_CLOCK
 from ..ops.decode_attention import (
     DEFAULT_PAGE_SIZE, contiguous_as_paged, decode_plan,
     dense_decode_reference, dense_verify_reference, flash_decode_attention,
@@ -1016,8 +1018,35 @@ class ContinuousBatcher:
                  n_pages: Optional[int] = None,
                  prefix_cache: bool = False,
                  speculative: bool = False, gamma: int = 4,
-                 fault_injector=None):
+                 fault_injector=None, tracer=None, clock=None,
+                 flight_capacity: int = 256):
         self.params = params
+        # Observability (obs/): ``clock`` is the injected time source
+        # every duration/timestamp in the engine reads (chaos and trace
+        # tests pass a VirtualClock); ``tracer`` (obs.Tracer, None in
+        # production — one `is None` check per phase) collects the
+        # request-lifecycle spans queue|admit|prefill|decode_chunk|
+        # verify|rewind|reap; the flight recorder (always on — one host
+        # dict append per step, capacity 0 disables) keeps the per-step
+        # ring that drain() folds into the snapshot. ``_obs_mu`` guards
+        # the cross-thread observability state so pool_metrics() exports
+        # ONE consistent lock snapshot (watchdog age, spec gauges and
+        # the drained phase batch can never tear against each other
+        # mid-step).
+        self._clock = clock or SYSTEM_CLOCK
+        self._tracer = tracer
+        self._flight = (FlightRecorder(flight_capacity, self._clock)
+                        if flight_capacity else None)
+        self._obs_mu = threading.Lock()
+        # Bounded like every other obs buffer ("never block, never
+        # grow"): a traced engine nobody scrapes — or a contiguous
+        # engine, whose pool_metrics() is {} — must not leak host
+        # memory; overflow drops the OLDEST phase observations.
+        self._phase_buf: deque = deque(maxlen=4096)
+        self._timelines: "OrderedDict[int, list]" = OrderedDict()
+        self._rid_label: Dict[int, str] = {}
+        self._step_faults: list = []
+        self._step_admitted = 0
         # Chaos harness hook (testing/faults.py): the step loop fires
         # ``serve.step`` (drop/delay/preempt/page-pressure) and the
         # speculative proposer fires ``serve.propose`` per slot. None in
@@ -1039,7 +1068,7 @@ class ContinuousBatcher:
         # pool_metrics() derives tpu_serve_last_step_age_seconds from it,
         # the gauge an external liveness probe alerts on when the step
         # loop wedges (the failure drain/restore exists to bound).
-        self._last_step_t = time.monotonic()
+        self._last_step_t = self._clock.monotonic()
         self.cfg = cfg
         self.n_slots = n_slots
         self.chunk = chunk
@@ -1180,7 +1209,7 @@ class ContinuousBatcher:
         self._queue: list = []                       # (req id, prompt list)
         self._reads: list = []                       # deferred readbacks
         self._next_id = 0
-        # Per-request wall-clock (time.monotonic): submit → first token
+        # Per-request wall-clock (Clock.monotonic): submit → first token
         # VISIBLE TO THE HOST (TTFT) → completion. Timestamps are taken at
         # flush, not dispatch: a token a deferred readback hasn't
         # materialized yet cannot be sent to a client, so flush time is the
@@ -1259,9 +1288,79 @@ class ContinuousBatcher:
             tb *= 2
         return min(tb, self.S)
 
-    def submit(self, prompt, max_new: int) -> int:
+    # -- observability -----------------------------------------------------
+    _TIMELINE_MAX = 1024                  # completed-request timeline cap
+
+    def _rid(self, req_id: int) -> str:
+        """Span correlation label for a request: the caller-supplied
+        trace id (submit(trace_id=...)) or ``req-<n>`` — the scheduler
+        plane tags its spans with the pod name, so a caller that uses
+        one string for both gets a single scheduler→engine timeline."""
+        return self._rid_label.get(req_id, f"req-{req_id}")
+
+    def _obs_span(self, phase: str, t0: float, t1: float,
+                  rid: Optional[int] = None, lane: str = "engine",
+                  fold: bool = True, **attrs) -> None:
+        """Record one phase span: to the tracer (with the rid label) and
+        — when ``fold`` — into the phase-duration batch pool_metrics()
+        drains atomically into the Prometheus histogram. Per-slot lane
+        copies of an engine-wide dispatch span pass fold=False so the
+        histogram counts each dispatch once."""
+        label = None if rid is None else self._rid(rid)
+        self._tracer.record(phase, t0, t1, lane=lane, rid=label, **attrs)
+        evicted: list = []
+        with self._obs_mu:
+            if fold:
+                self._phase_buf.append((phase, t1 - t0))
+            if rid is not None:
+                tl = self._timelines.get(rid)
+                if tl is None:
+                    while len(self._timelines) >= self._TIMELINE_MAX:
+                        evicted.append(self._timelines.popitem(last=False)[0])
+                    tl = self._timelines.setdefault(rid, [])
+                tl.append({"phase": phase, "t0": t0, "t1": t1, **attrs})
+        for old in evicted:
+            # The trace label lives exactly as long as the timeline that
+            # needs it — no slow leak across millions of requests
+            # (GIL-atomic dict pop; _rid_label is not lock-owned state).
+            self._rid_label.pop(old, None)
+
+    def request_timeline(self, rid) -> Optional[Dict[str, object]]:
+        """Per-request timeline summary (tracer attached; None when the
+        request was never traced): the ordered phase events plus a
+        per-phase rollup {count, total_s}. ``rid`` is the integer
+        request id or its trace label."""
+        if isinstance(rid, str):
+            # .copy() is one C-level op under the GIL — iterating the
+            # live dict here would race submit()'s inserts and the
+            # timeline eviction's pops (RuntimeError mid-iteration).
+            matches = [i for i, lbl in self._rid_label.copy().items()
+                       if lbl == rid]
+            if not matches and rid.startswith("req-"):
+                try:
+                    matches = [int(rid[4:])]
+                except ValueError:
+                    matches = []
+            if not matches:
+                return None
+            rid = matches[-1]
+        with self._obs_mu:
+            events = [dict(e) for e in self._timelines.get(rid, [])]
+        if not events:
+            return None
+        phases: Dict[str, Dict[str, float]] = {}
+        for e in events:
+            p = phases.setdefault(e["phase"], {"count": 0, "total_s": 0.0})
+            p["count"] += 1
+            p["total_s"] += e["t1"] - e["t0"]
+        return {"request": rid, "trace_id": self._rid(rid),
+                "events": events, "phases": phases}
+
+    def submit(self, prompt, max_new: int, trace_id: Optional[str] = None) -> int:
         """Queue one request; returns its id. prompt: 1-D int sequence up
-        to the cache capacity (padded to the next bucket rung)."""
+        to the cache capacity (padded to the next bucket rung).
+        ``trace_id`` labels the request's spans for cross-plane
+        correlation (defaults to ``req-<id>``)."""
         if self._drained:
             raise RuntimeError(
                 "engine is drained: admission is stopped; restore() the "
@@ -1293,7 +1392,9 @@ class ContinuousBatcher:
         self._next_id += 1
         self._budget[req_id] = max_new
         self._out[req_id] = []
-        self._arrival[req_id] = time.monotonic()
+        self._arrival[req_id] = self._clock.monotonic()
+        if trace_id is not None:
+            self._rid_label[req_id] = str(trace_id)
         self._queue.append((req_id, prompt))
         return req_id
 
@@ -1359,13 +1460,27 @@ class ContinuousBatcher:
             raise RuntimeError(
                 "engine is drained: restore() the snapshot into a fresh "
                 "engine")
-        self._last_step_t = time.monotonic()
+        with self._obs_mu:
+            self._last_step_t = self._clock.monotonic()
+        self._step_faults = []
         if self._faults is not None:
             # Chaos hook: may raise (drop → InjectedFault, preempt →
             # Preempted — the in-process SIGTERM the drain/restore loop
             # catches) BEFORE any state changes this step; passive
-            # page-pressure rules are applied to the allocator.
-            self._apply_page_pressure(self._faults.fire("serve.step"))
+            # page-pressure rules are applied to the allocator. The
+            # injections this step fires (raising or not) land in the
+            # flight recorder, so a post-preemption ring shows WHAT hit
+            # the engine, not just that it stopped.
+            n0 = len(self._faults.log)
+            try:
+                rules = self._faults.fire("serve.step")
+            except BaseException:
+                if self._flight is not None:
+                    self._flight.record("fault", injected=[
+                        k for _, _, k in self._faults.log[n0:]])
+                raise
+            self._step_faults = [k for _, _, k in self._faults.log[n0:]]
+            self._apply_page_pressure(rules)
         if self.layout == "paged":
             if self.spec:
                 return self._step_spec_paged()
@@ -1405,6 +1520,12 @@ class ContinuousBatcher:
             self._cursor = cursor
             slot = free.pop()
             adm.append((req_id, slot, cursor, prompt, tb))
+            if self._tracer is not None:
+                now = self._clock.monotonic()
+                self._obs_span("queue", self._arrival.get(req_id, now),
+                               now, rid=req_id, prompt_len=P)
+                self._obs_span("admit", now, self._clock.monotonic(),
+                               rid=req_id, slot=slot, bucket=tb)
             self._budget[req_id] -= 1                # first token = prefill
             if self._budget[req_id] <= 0:            # max_new == 1
                 finished.append(req_id)
@@ -1429,6 +1550,7 @@ class ContinuousBatcher:
                 [p + [0] * (tb - len(p)) for _, _, _, p, _ in rows],
                 np.int32)
             self._dispatch_no += 1
+            t_pf = self._clock.monotonic()
             (self._k, self._v, self._ks, self._vs, self._bitmap,
              self._rope_pos, self._last, firsts_arr) = self._prefill(
                 self.params, self._k, self._v, self._ks, self._vs,
@@ -1438,6 +1560,14 @@ class ContinuousBatcher:
                 tokens,
                 np.asarray([len(p) for _, _, _, p, _ in rows], np.int32),
                 np.int32(self._dispatch_no))
+            if self._tracer is not None:
+                t1 = self._clock.monotonic()
+                self._obs_span("prefill", t_pf, t1, bucket=tb,
+                               requests=[self._rid(r)
+                                         for r, _, _, _, _ in run])
+                for rid, slot, _, _, _ in run:
+                    self._obs_span("prefill", t_pf, t1, rid=rid,
+                                   lane=f"slot{slot}", fold=False)
             # Prefill already produced each request's FIRST token from the
             # prompt's last-position logits (greedy argmax when
             # temperature == 0 — matching the static generate path — else
@@ -1446,10 +1576,16 @@ class ContinuousBatcher:
                 ("firsts", firsts_arr, [rid for rid, _, _, _, _ in run]))
 
         if not self._slot_req:
+            if self._flight is not None:
+                self._flight.record("admit_only", active=0,
+                                    admitted=len(adm),
+                                    retired=len(finished),
+                                    faults=self._step_faults)
             return finished
         active = np.asarray(
             [s in self._slot_req for s in range(self.n_slots)])
         self._dispatch_no += 1
+        t_dec = self._clock.monotonic()
         (self._k, self._v, self._ks, self._vs, self._bitmap, cursor,
          self._rope_pos, self._last, toks) = self._decode(
             self.params, self._k, self._v, self._ks, self._vs, self._bitmap,
@@ -1467,7 +1603,26 @@ class ContinuousBatcher:
                 finished.append(req_id)
                 del self._budget[req_id]
                 del self._slot_req[slot]             # slot free NOW
+                if self._tracer is not None:
+                    now = self._clock.monotonic()
+                    self._obs_span("reap", now, now, rid=req_id, slot=slot)
         self._reads.append(("chunk", toks, takes))
+        if self._tracer is not None:
+            t1 = self._clock.monotonic()
+            self._obs_span("decode_chunk", t_dec, t1,
+                           active=int(active.sum()), chunk=self.chunk)
+            for req_id, slot, take in takes:
+                self._obs_span("decode_chunk", t_dec, t1, rid=req_id,
+                               lane=f"slot{slot}", fold=False, tokens=take)
+        if self._flight is not None:
+            self._flight.record(
+                "decode",
+                wall_ms=round(
+                    (self._clock.monotonic() - t_dec) * 1e3, 3),
+                active=int(active.sum()), admitted=len(adm),
+                tokens=sum(t for _, _, t in takes),
+                retired=len(finished), cursor=self._cursor,
+                faults=self._step_faults)
         return finished
 
     # -- paged step --------------------------------------------------------
@@ -1534,6 +1689,8 @@ class ContinuousBatcher:
         while free and self._queue and len(adm) < self.n_slots:
             req_id, prompt = self._queue[0]
             P = len(prompt)
+            t_adm = self._clock.monotonic()
+            evicted = 0
             hits: list = []
             if self._prefix is not None:
                 # Longest cached page-aligned prefix (always leaves >= 1
@@ -1551,7 +1708,8 @@ class ContinuousBatcher:
             if self._prefix is not None and need > self._alloc.free_count:
                 # Tree-only pages are reclaimable capacity, not occupancy:
                 # evict the coldest unshared leaves to make room.
-                self._prefix.evict(need - self._alloc.free_count)
+                evicted = need - self._alloc.free_count
+                self._prefix.evict(evicted)
             pages = self._alloc.alloc(
                 need, count_denied=req_id != self._last_denied)
             if pages is None:
@@ -1563,6 +1721,16 @@ class ContinuousBatcher:
                 # denial counts ONCE per request, not once per retry step.
                 if hits:
                     self._alloc.free(hits)           # unwind the match pin
+                if self._tracer is not None \
+                        and req_id != self._last_denied:
+                    # The admission-stall marker (deduped like the
+                    # denial metric): the head is blocked on pages, so
+                    # its queue span keeps growing until a retire frees
+                    # some.
+                    self._tracer.event(
+                        "page_shortage", lane="engine",
+                        rid=self._rid(req_id), need=need,
+                        free=self._alloc.free_count)
                 self._last_denied = req_id
                 break
             if req_id == self._last_denied:
@@ -1585,6 +1753,13 @@ class ContinuousBatcher:
                 * self.page_size
             adm.append((req_id, slot, pages, prompt,
                         (tb, self._hb_bucket(len(hits))), hits))
+            if self._tracer is not None:
+                self._obs_span("queue", self._arrival.get(req_id, t_adm),
+                               t_adm, rid=req_id, prompt_len=P)
+                self._obs_span("admit", t_adm, self._clock.monotonic(),
+                               rid=req_id, slot=slot, bucket=tb,
+                               hit_pages=len(hits), new_pages=len(pages),
+                               evicted=evicted)
             self._budget[req_id] -= 1                # first token = prefill
             if self._budget[req_id] <= 0:            # max_new == 1
                 finished.append(req_id)
@@ -1629,6 +1804,7 @@ class ContinuousBatcher:
                 [len(h) * self.page_size for _, _, _, _, _, h in rows],
                 np.int32)
             self._dispatch_no += 1
+            t_pf = self._clock.monotonic()
             (self._k, self._v, self._ks, self._vs, self._lens, self._last,
              firsts_arr) = self._prefill(
                 self.params, self._k, self._v, self._ks, self._vs,
@@ -1639,8 +1815,19 @@ class ContinuousBatcher:
                 np.int32(self._dispatch_no))
             self._reads.append(
                 ("firsts", firsts_arr, [rid for rid, *_ in run]))
+            if self._tracer is not None:
+                t1 = self._clock.monotonic()
+                self._obs_span("prefill", t_pf, t1, bucket=tb,
+                               prefix_bucket=hb,
+                               requests=[self._rid(r)
+                                         for r, *_ in run])
+                for rid, slot, _, _, _, h in run:
+                    self._obs_span("prefill", t_pf, t1, rid=rid,
+                                   lane=f"slot{slot}", fold=False,
+                                   hit_pages=len(h))
         for pages, hits, prompt in free_after:
             self._retire_pages(pages, hits, prompt)
+        self._step_admitted = len(adm)               # flight-record input
         return finished
 
     def _device_table(self):
@@ -1656,11 +1843,18 @@ class ContinuousBatcher:
         """Admit (see _admit_paged), then dispatch one decode chunk."""
         finished = self._admit_paged()
         if not self._slot_req:
+            if self._flight is not None:
+                self._flight.record("admit_only", active=0,
+                                    admitted=self._step_admitted,
+                                    retired=len(finished),
+                                    pool_free=self._alloc.free_count,
+                                    faults=self._step_faults)
             return finished
         active = np.asarray(
             [s in self._slot_req for s in range(self.n_slots)])
         table = self._device_table()
         self._dispatch_no += 1
+        t_dec = self._clock.monotonic()
         (self._k, self._v, self._ks, self._vs, self._table, self._lens,
          self._last, toks) = self._decode(
             self.params, self._k, self._v, self._ks, self._vs, table,
@@ -1676,8 +1870,30 @@ class ContinuousBatcher:
                 finished.append(req_id)
                 del self._budget[req_id]
                 del self._slot_req[slot]             # slot free NOW
+                t_rp = self._clock.monotonic()
                 self._free_slot_pages(slot)          # pages free NOW too
+                if self._tracer is not None:
+                    self._obs_span("reap", t_rp, self._clock.monotonic(),
+                                   rid=req_id, slot=slot)
         self._reads.append(("chunk", toks, takes))
+        if self._tracer is not None:
+            t1 = self._clock.monotonic()
+            self._obs_span("decode_chunk", t_dec, t1,
+                           active=int(active.sum()), chunk=self.chunk)
+            for req_id, slot, take in takes:
+                self._obs_span("decode_chunk", t_dec, t1, rid=req_id,
+                               lane=f"slot{slot}", fold=False, tokens=take)
+        if self._flight is not None:
+            self._flight.record(
+                "decode",
+                wall_ms=round(
+                    (self._clock.monotonic() - t_dec) * 1e3, 3),
+                active=int(active.sum()), admitted=self._step_admitted,
+                tokens=sum(t for _, _, t in takes),
+                retired=len(finished),
+                pool_free=self._alloc.free_count,
+                pool_in_use=self._alloc.in_use,
+                faults=self._step_faults)
         return finished
 
     def _mirror_append(self, hist: list, idx: dict, tk: int) -> None:
@@ -1760,13 +1976,15 @@ class ContinuousBatcher:
             [s in self._slot_req for s in range(self.n_slots)])
         table = self._device_table()
         self._dispatch_no += 1
+        t_ver = self._clock.monotonic()
         (self._k, self._v, self._ks, self._vs, self._table, self._lens,
          self._last, toks, accepts) = self._decode(
             self.params, self._k, self._v, self._ks, self._vs, table,
             self._lens, self._last, props, active)
         # graftcheck: ignore[host-sync] — sanctioned: speculative scheduling is content-dependent (accept lengths gate budgets and the next proposals), one readback per verify dispatch by design
         toks, accepts = jax.device_get((toks, accepts))
-        self._spec_dispatches += 1
+        t_ver1 = self._clock.monotonic()
+        step_used = step_emitted = 0
 
         for slot, req_id in list(self._slot_req.items()):
             acc = int(accepts[slot])
@@ -1777,17 +1995,53 @@ class ContinuousBatcher:
             # proposals, and those rows are rewound like any rejection —
             # keeps accept_rate and tokens_per_dispatch telling one story.
             used = take - 1
-            self._spec_slot_steps += 1
-            self._spec_proposed += self.gamma
-            self._spec_accepted += used
-            self._spec_emitted += take
-            self._spec_rewound += self.gamma - used
+            step_used += used
+            step_emitted += take
+            with self._obs_mu:
+                self._spec_slot_steps += 1
+                self._spec_proposed += self.gamma
+                self._spec_accepted += used
+                self._spec_emitted += take
+                self._spec_rewound += self.gamma - used
+            if self._tracer is not None:
+                self._obs_span("verify", t_ver, t_ver1, rid=req_id,
+                               lane=f"slot{slot}", fold=False,
+                               accepted=used, tokens=take)
+                if self.gamma - used:
+                    # The rewind is a pure host-side lens clamp — an
+                    # instant, but the span makes rewind STORMS (0-accept
+                    # waves burning whole verify windows) visible.
+                    self._obs_span("rewind", t_ver1, t_ver1, rid=req_id,
+                                   lane=f"slot{slot}",
+                                   rewound=self.gamma - used)
             self._budget[req_id] -= take
             if self._budget[req_id] <= 0:
                 finished.append(req_id)
                 del self._budget[req_id]
                 del self._slot_req[slot]             # slot free NOW
+                t_rp = self._clock.monotonic()
                 self._free_slot_pages(slot)          # pages free NOW too
+                if self._tracer is not None:
+                    self._obs_span("reap", t_rp, self._clock.monotonic(),
+                                   rid=req_id, slot=slot)
+        with self._obs_mu:
+            self._spec_dispatches += 1
+        n_active = int(active.sum())
+        if self._tracer is not None:
+            self._obs_span("verify", t_ver, t_ver1, active=n_active,
+                           gamma=self.gamma)
+        if self._flight is not None:
+            self._flight.record(
+                "verify",
+                wall_ms=round((t_ver1 - t_ver) * 1e3, 3),
+                active=n_active, admitted=self._step_admitted,
+                tokens=step_emitted,
+                accept_rate=(round(step_used / (n_active * self.gamma), 4)
+                             if n_active else 0.0),
+                retired=len(finished),
+                pool_free=self._alloc.free_count,
+                pool_in_use=self._alloc.in_use,
+                faults=self._step_faults)
         return finished
 
     # -- chaos / error isolation -------------------------------------------
@@ -1896,7 +2150,7 @@ class ContinuousBatcher:
                 "is pool pages + block tables)")
         if self._drained:
             raise RuntimeError("engine already drained")
-        t0 = time.perf_counter()
+        t0 = self._clock.monotonic()
         self._flush()
         if self._chaos_pages:                # chaos hostages are not state
             self._alloc.free(self._chaos_pages)
@@ -1935,6 +2189,15 @@ class ContinuousBatcher:
                              for _ in range(2)]
         # graftcheck: ignore[host-sync] — sanctioned: drain-time readback of two [n_slots] vectors
         lens, last = jax.device_get((self._lens, self._last))
+        if self._flight is not None:
+            # Recorded BEFORE the payload dump so the drain marker itself
+            # rides the snapshot: the restored ring then reads
+            # ...decode, drain, restore... across the process boundary.
+            self._flight.record(
+                "drain", pages=len(ids),
+                in_flight=len(self._slot_req), queued=len(self._queue),
+                wall_ms=round(
+                    (self._clock.monotonic() - t0) * 1e3, 3))
         snap = ServingSnapshot(
             fingerprint=self.fingerprint(),
             page_ids=ids,
@@ -1965,13 +2228,18 @@ class ContinuousBatcher:
             tree_paths=tree_paths,
             arrival=dict(self._arrival),
             first_tok=dict(self._first_tok),
-            drained_mono=time.monotonic(),
-            drained_wall=time.time(),
+            drained_mono=self._clock.monotonic(),
+            drained_wall=self._clock.wall(),
             skipped_tokens=self._skipped_tokens,
+            flight=(self._flight.to_payload()
+                    if self._flight is not None else []),
         )
         snap.validate()
         self._drained = True
-        self._drain_s = time.perf_counter() - t0
+        self._drain_s = self._clock.monotonic() - t0
+        if self._tracer is not None:
+            self._obs_span("drain", t0, self._clock.monotonic(),
+                           pages=len(ids))
         return snap
 
     def restore(self, snap: ServingSnapshot) -> int:
@@ -2004,7 +2272,7 @@ class ContinuousBatcher:
                 "queue, no allocated pages)")
         check_fingerprint(snap.fingerprint, self.fingerprint())
         snap.validate()
-        t0 = time.perf_counter()
+        t0 = self._clock.monotonic()
         new = self._alloc.alloc(len(snap.page_ids))
         if new is None:
             raise SnapshotError(
@@ -2062,15 +2330,27 @@ class ContinuousBatcher:
         self._next_id = snap.next_id
         self._eos_scanned = dict(snap.eos_scanned)
         self._skipped_tokens = snap.skipped_tokens
-        now_m, now_w = time.monotonic(), time.time()
+        now_m, now_w = self._clock.monotonic(), self._clock.wall()
         self._arrival = snap.rebased_clock(snap.arrival, now_m, now_w)
         self._first_tok = snap.rebased_clock(snap.first_tok, now_m, now_w)
         self._alloc.assert_consistent()
         self._resumed = snap.n_requests_in_flight
-        self._restore_s = time.perf_counter() - t0
+        self._restore_s = self._clock.monotonic() - t0
+        if self._flight is not None:
+            # The pre-preemption ring survives the process boundary: the
+            # restored engine can explain behavior it never exhibited.
+            self._flight.seed(snap.flight)
+            self._flight.record(
+                "restore", resumed=self._resumed,
+                pages=len(snap.page_ids),
+                downtime_s=round(max(0.0, now_w - snap.drained_wall), 3),
+                wall_ms=round(self._restore_s * 1e3, 3))
+        if self._tracer is not None:
+            self._obs_span("restore", t0, self._clock.monotonic(),
+                           resumed=self._resumed)
         return self._resumed
 
-    def pool_metrics(self) -> Dict[str, float]:
+    def pool_metrics(self) -> Dict[str, object]:
         """Page-pool health (paged layout only; {} otherwise): total/free/
         in-use/cached/watermark page counts, alloc/free/denied churn, the
         instantaneous utilization, and — with the prefix cache on — the
@@ -2078,7 +2358,11 @@ class ContinuousBatcher:
         tokens skipped). The fragmentation-and-reuse observability the
         serving entrypoint publishes next to the latency records
         (metrics.exporter.export_serving_pool maps it onto Prometheus
-        gauges)."""
+        gauges). With a tracer attached the snapshot also carries
+        ``phase_durations`` — a drained-exactly-once batch of
+        ``(phase, seconds)`` pairs taken in the SAME lock snapshot as the
+        watchdog/spec gauges (export_serving_pool folds it into the
+        ``tpu_serve_phase_duration_seconds{phase=}`` histogram)."""
         if self.layout != "paged":
             return {}
         out = self._alloc.metrics()
@@ -2091,29 +2375,42 @@ class ContinuousBatcher:
         out["restore_duration_seconds"] = self._restore_s or 0.0
         out["requests_resumed_total"] = float(self._resumed)
         out["request_errors_total"] = float(self._request_errors)
-        # Age is only a wedge signal while there is work to step: an
-        # idle engine (nothing queued, no active slots) legitimately
-        # stops stepping, and reporting its quiet time would page the
-        # probe on every traffic lull.
-        out["last_step_age_seconds"] = (
-            max(0.0, time.monotonic() - self._last_step_t)
-            if self.pending else 0.0)
         if self._prefix is not None:
             out.update(self._prefix.metrics())
             out["prefill_tokens_skipped"] = float(self._skipped_tokens)
-        if self.spec:
-            # Speculation gauges: accept rate (proposals accepted /
-            # proposed — how often prompt-lookup pays), committed tokens
-            # per active slot per verify dispatch (the per-slot tok/s
-            # multiplier vs the 1.0 of plain decode), and the cumulative
-            # overshoot rows rewound by the lens clamp.
-            out["spec_accept_rate"] = (
-                self._spec_accepted / self._spec_proposed
-                if self._spec_proposed else 0.0)
-            out["spec_tokens_per_dispatch"] = (
-                self._spec_emitted / self._spec_slot_steps
-                if self._spec_slot_steps else 0.0)
-            out["spec_rewound_tokens_total"] = float(self._spec_rewound)
+        # ONE lock snapshot for everything the step loop mutates: the
+        # watchdog age, the spec gauges and the drained phase batch all
+        # come from the same instant, so a scrape racing a step can
+        # never pair (say) this step's accept counters with last step's
+        # age — the torn-read class this lock exists to close. The
+        # phase batch drains exactly once (into the returned dict);
+        # export_serving_pool folds it into the
+        # tpu_serve_phase_duration_seconds{phase=} histogram.
+        with self._obs_mu:
+            # Age is only a wedge signal while there is work to step: an
+            # idle engine (nothing queued, no active slots) legitimately
+            # stops stepping, and reporting its quiet time would page the
+            # probe on every traffic lull.
+            out["last_step_age_seconds"] = (
+                max(0.0, self._clock.monotonic() - self._last_step_t)
+                if self.pending else 0.0)
+            if self.spec:
+                # Speculation gauges: accept rate (proposals accepted /
+                # proposed — how often prompt-lookup pays), committed
+                # tokens per active slot per verify dispatch (the
+                # per-slot tok/s multiplier vs the 1.0 of plain decode),
+                # and the cumulative overshoot rows rewound by the lens
+                # clamp.
+                out["spec_accept_rate"] = (
+                    self._spec_accepted / self._spec_proposed
+                    if self._spec_proposed else 0.0)
+                out["spec_tokens_per_dispatch"] = (
+                    self._spec_emitted / self._spec_slot_steps
+                    if self._spec_slot_steps else 0.0)
+                out["spec_rewound_tokens_total"] = float(self._spec_rewound)
+            if self._phase_buf:
+                out["phase_durations"] = tuple(self._phase_buf)
+                self._phase_buf.clear()
         return out
 
     def _flush(self) -> None:
@@ -2123,7 +2420,7 @@ class ContinuousBatcher:
             return
         # graftcheck: ignore[host-sync] — sanctioned: THE one batched readback (one tunnel round trip per drain, the engine's whole design)
         arrays = jax.device_get([arr for _, arr, _ in self._reads])
-        now = time.monotonic()
+        now = self._clock.monotonic()
         for (kind, _, meta), vals in zip(self._reads, arrays):
             if kind == "firsts":
                 for req_id, val in zip(meta, vals):  # pad rows fall off
@@ -2142,7 +2439,7 @@ class ContinuousBatcher:
         BEFORE eos truncation — what the engine decoded, which is what its
         throughput cost)."""
         if now is None:
-            now = time.monotonic()
+            now = self._clock.monotonic()
         for rid in req_ids:
             arrival = self._arrival.pop(rid, now)
             first = self._first_tok.pop(rid, now)
@@ -2177,10 +2474,14 @@ class ContinuousBatcher:
                 del self._slot_req[slot]
                 del self._budget[req_id]
                 self._eos_scanned.pop(req_id, None)
+                t_rp = self._clock.monotonic()
                 if self.layout == "paged":
                     # Early stop returns the whole worst-case reservation —
                     # including the never-written tail — immediately.
                     self._free_slot_pages(slot)
+                if self._tracer is not None:
+                    self._obs_span("reap", t_rp, self._clock.monotonic(),
+                                   rid=req_id, slot=slot, eos=True)
                 reaped.append(req_id)
             else:
                 self._eos_scanned[req_id] = len(out)

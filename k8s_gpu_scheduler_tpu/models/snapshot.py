@@ -26,7 +26,10 @@ serialized:
   clocks (re-based at restore so latency records survive a process
   boundary);
 - the **prefix tree** as root-to-leaf token paths with their page ids,
-  in LRU order, so reuse state survives too.
+  in LRU order, so reuse state survives too;
+- the **flight recorder ring** (obs/flight.py): the per-step records of
+  the drained engine's recent behavior, re-seeded into the restored
+  engine so a post-preemption investigation can read the black box.
 
 What is deliberately NOT preserved: speculative proposals (recomputed
 from the token mirrors — the bigram index is a pure function of
@@ -84,9 +87,14 @@ class ServingSnapshot:
     tree_paths: List[Tuple[List[int], List[int]]]  # (tokens, pages), LRU order
     arrival: Dict[int, float] = field(default_factory=dict)
     first_tok: Dict[int, float] = field(default_factory=dict)
-    drained_mono: float = 0.0              # time.monotonic() at drain
-    drained_wall: float = 0.0              # time.time() at drain
+    drained_mono: float = 0.0              # Clock.monotonic() at drain
+    drained_wall: float = 0.0              # Clock.wall() at drain
     skipped_tokens: int = 0
+    # Flight-recorder ring (obs/flight.py to_payload(), JSON-safe per-step
+    # records): the drained engine's black box, re-seeded into the
+    # restored engine's recorder so post-preemption debugging can see
+    # pre-preemption behavior. Default [] keeps older snapshots loading.
+    flight: List[Dict[str, Any]] = field(default_factory=list)
 
     # -- derived -----------------------------------------------------------
     @property
@@ -165,6 +173,7 @@ class ServingSnapshot:
             "drained_mono": float(self.drained_mono),
             "drained_wall": float(self.drained_wall),
             "skipped_tokens": int(self.skipped_tokens),
+            "flight": list(self.flight),
         }
 
     def to_pytree(self) -> Dict[str, np.ndarray]:
@@ -222,6 +231,7 @@ class ServingSnapshot:
             drained_mono=doc["drained_mono"],
             drained_wall=doc["drained_wall"],
             skipped_tokens=doc["skipped_tokens"],
+            flight=list(doc.get("flight", [])),
         )
         snap.validate()
         return snap

@@ -1,0 +1,55 @@
+"""obs — request-lifecycle tracing and the engine flight recorder.
+
+The reference scheduler's whole pitch is SLO-aware placement driven by
+live telemetry (DCGM → Prometheus → Score), yet a latency number alone
+cannot answer *why* a request was slow: queue wait, gang-bind latency, a
+page-shortage admission stall, a prefill chunk blocking decode, a
+speculative rewind storm, or a drain/restore gap. This package is the
+measurement substrate the ROADMAP's next tentpoles (disaggregated
+prefill/decode, cache-aware fleet routing) attribute latency with:
+
+- :mod:`~.trace` — the span API: an injectable :class:`Clock` (so chaos
+  and trace tests run on virtual time), :class:`Tracer` with a
+  thread-safe bounded drop-oldest buffer (the hot path never blocks and
+  never grows), ``span()`` context managers and explicit
+  ``record()``/``event()`` for phases whose endpoints live on different
+  host paths (queue wait: submit → admission).
+- :mod:`~.flight` — the engine flight recorder: a fixed-size ring of
+  per-step records (step kind, wall ms, active slots, tokens emitted,
+  accept rate, pool watermark, admissions/evictions/retires, fault
+  injections) that rides into ``ServingSnapshot`` so a post-preemption
+  engine can explain its pre-preemption behavior.
+- :mod:`~.export` — Chrome-trace/Perfetto JSON export (one lane per
+  engine slot, one per control-plane component) plus the fold of
+  drained phase durations into the ``tpu_serve_phase_duration_seconds``
+  Prometheus histogram.
+
+Tracing is off-by-default-cheap: production constructs engines and
+schedulers with ``tracer=None`` (one ``is None`` check per phase), and
+``bench.py --leg obs_overhead`` CI-asserts the tracing-ON steady-state
+decode leg within 2% of tracing-off. Span calls are HOST-side by
+contract — inside jit-traced code they would be host syncs, which
+graftcheck's ``trace-in-jit`` pass (analysis/tracelint.py) makes a lint
+error.
+"""
+from .trace import (
+    Clock, Span, SystemClock, Tracer, VirtualClock, SYSTEM_CLOCK,
+)
+from .flight import FlightRecorder
+from .export import (
+    PHASES, to_perfetto, validate_perfetto, write_perfetto,
+)
+
+__all__ = [
+    "Clock",
+    "SystemClock",
+    "VirtualClock",
+    "SYSTEM_CLOCK",
+    "Span",
+    "Tracer",
+    "FlightRecorder",
+    "PHASES",
+    "to_perfetto",
+    "validate_perfetto",
+    "write_perfetto",
+]

@@ -15,10 +15,10 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
-import time
 from typing import Dict, List, Optional, Tuple
 
 from ..api.objects import Pod
+from ..obs import SYSTEM_CLOCK
 
 ANN_PRIORITY = "tpu.sched/priority"
 
@@ -31,11 +31,20 @@ def pod_priority(pod: Pod) -> int:
 
 
 class SchedulingQueue:
-    def __init__(self, backoff_initial_s: float = 1.0, backoff_max_s: float = 10.0) -> None:
+    def __init__(self, backoff_initial_s: float = 1.0, backoff_max_s: float = 10.0,
+                 clock=None) -> None:
+        # Injected time source (obs.Clock): backoff readiness and queue-wait
+        # timestamps are DURATION math and ride the monotonic clock; tests
+        # can pass a VirtualClock and step backoff deterministically.
+        self._clock = clock or SYSTEM_CLOCK
         self._mu = threading.Condition()
         self._heap: List[Tuple[int, float, int, Pod]] = []
         self._queued_uids: Dict[str, int] = {}  # uid -> attempt count
         self._backoff: Dict[str, Tuple[float, Pod]] = {}  # uid -> (ready_at, pod)
+        # uid -> first-enqueue monotonic timestamp: the scheduler's
+        # sched_queue span measures pod-arrival -> pop from it (survives
+        # backoff round-trips — queue wait is e2e, not per-attempt).
+        self._enqueued: Dict[str, float] = {}
         self._seq = itertools.count()
         self._backoff_initial = backoff_initial_s
         self._backoff_max = backoff_max_s
@@ -48,6 +57,8 @@ class SchedulingQueue:
             if pod.metadata.uid in self._queued_uids or pod.metadata.uid in self._backoff:
                 return
             self._queued_uids[pod.metadata.uid] = 0
+            self._enqueued.setdefault(pod.metadata.uid,
+                                      self._clock.monotonic())
             self._push_locked(pod)
             self._mu.notify()
 
@@ -57,7 +68,10 @@ class SchedulingQueue:
             attempts = self._queued_uids.get(pod.metadata.uid, 0) + 1
             self._queued_uids[pod.metadata.uid] = attempts
             delay = min(self._backoff_initial * (2 ** (attempts - 1)), self._backoff_max)
-            self._backoff[pod.metadata.uid] = (time.monotonic() + delay, pod)
+            self._backoff[pod.metadata.uid] = (
+                self._clock.monotonic() + delay, pod)
+            self._enqueued.setdefault(pod.metadata.uid,
+                                      self._clock.monotonic())
             self._mu.notify()
 
     def remove(self, pod: Pod) -> None:
@@ -65,6 +79,7 @@ class SchedulingQueue:
         with self._mu:
             self._queued_uids.pop(pod.metadata.uid, None)
             self._backoff.pop(pod.metadata.uid, None)
+            self._enqueued.pop(pod.metadata.uid, None)
             # lazily dropped from the heap at pop time
 
     def move_all_to_active(self, _reason: str = "") -> None:
@@ -81,6 +96,14 @@ class SchedulingQueue:
         with self._mu:
             self._queued_uids.pop(pod.metadata.uid, None)
             self._backoff.pop(pod.metadata.uid, None)
+            self._enqueued.pop(pod.metadata.uid, None)
+
+    def enqueued_at(self, uid: str) -> Optional[float]:
+        """First-enqueue monotonic timestamp of a still-pipelined pod
+        (None once done/removed) — the t0 of the scheduler's queue-wait
+        span."""
+        with self._mu:
+            return self._enqueued.get(uid)
 
     def close(self) -> None:
         with self._mu:
@@ -91,7 +114,8 @@ class SchedulingQueue:
     def pop(self, timeout: Optional[float] = None) -> Optional[Pod]:
         """Next pod to schedule, honoring backoff readiness; None on timeout
         or close."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None \
+            else self._clock.monotonic() + timeout
         with self._mu:
             while True:
                 if self._closed:
@@ -102,7 +126,7 @@ class SchedulingQueue:
                     if pod.metadata.uid in self._queued_uids and pod.metadata.uid not in self._backoff:
                         return pod
                     # stale entry (removed or re-backed-off) — skip
-                now = time.monotonic()
+                now = self._clock.monotonic()
                 if deadline is not None and now >= deadline:
                     return None  # None strictly means timeout or close
                 waits = []
@@ -127,7 +151,7 @@ class SchedulingQueue:
         )
 
     def _promote_ready_locked(self) -> None:
-        now = time.monotonic()
+        now = self._clock.monotonic()
         for uid, (ready_at, pod) in list(self._backoff.items()):
             if ready_at <= now:
                 del self._backoff[uid]

@@ -25,11 +25,11 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from dataclasses import dataclass
 from typing import Dict, Optional
 
 from ..api.objects import ANN_RESHAPE_STATE, ANN_SLICE_CONFIG, Node
+from ..obs import SYSTEM_CLOCK
 from ..registry.inventory import HEARTBEAT_SUFFIX, node_key
 
 log = logging.getLogger(__name__)
@@ -42,7 +42,15 @@ class _Pending:
     node_name: str
     target: str
     previous: str
-    requested_at: float
+    # TWO request timestamps, deliberately: the timeout/auto-confirm math
+    # is a DURATION and rides the monotonic clock (the old single
+    # time.time() field meant an NTP step forward instantly timed out and
+    # rolled back a healthy reshape, and a step backward stalled the
+    # timeout — the wall-clock-for-duration bug the obs.Clock sweep
+    # found); the agent-heartbeat comparison crosses processes and stays
+    # on the wall clock the agent publishes.
+    requested_mono: float
+    requested_wall: float
 
 
 class SliceReshaper:
@@ -54,9 +62,11 @@ class SliceReshaper:
         timeout_s: float = 60.0,
         auto_confirm_delay_s: float = 0.0,
         simulate_without_registry: bool = True,
+        clock=None,
     ):
         self.descriptor = descriptor
         self.registry = registry
+        self._clock = clock or SYSTEM_CLOCK
         self.poll_interval_s = poll_interval_s
         self.timeout_s = timeout_s
         # No-registry mode: confirmation is SIMULATED (there is no agent to
@@ -93,7 +103,8 @@ class SliceReshaper:
                 cfg = node.metadata.annotations.get(ANN_SLICE_CONFIG, "")
                 with self._mu:
                     self._pending[node.metadata.name] = _Pending(
-                        node.metadata.name, cfg, cfg, time.time()
+                        node.metadata.name, cfg, cfg,
+                        self._clock.monotonic(), self._clock.wall()
                     )
                 log.warning("adopted orphaned reshape on %s (config %r)",
                             node.metadata.name, cfg)
@@ -128,7 +139,8 @@ class SliceReshaper:
                 ANN_RESHAPE_STATE: STATE_APPLYING,
             })
             self._pending[node_name] = _Pending(
-                node_name, target_config, previous, time.time()
+                node_name, target_config, previous,
+                self._clock.monotonic(), self._clock.wall()
             )
         log.info("reshape %s: %r -> %r", node_name, previous, target_config)
         self._ensure_worker()
@@ -184,7 +196,7 @@ class SliceReshaper:
     def _advance(self, p: _Pending) -> None:
         if self._confirmed(p):
             self._finish(p, rollback=False)
-        elif time.time() - p.requested_at > self.timeout_s:
+        elif self._clock.monotonic() - p.requested_mono > self.timeout_s:
             log.warning("reshape of %s timed out; rolling back to %r",
                         p.node_name, p.previous)
             self._finish(p, rollback=True)
@@ -193,7 +205,8 @@ class SliceReshaper:
         """Agent republished since the request → the host observed the new
         partitioning (UUID-change parity, gpu_plugins.go:436-452)."""
         if self.registry is None:
-            if time.time() - p.requested_at < self.auto_confirm_delay_s:
+            if self._clock.monotonic() - p.requested_mono \
+                    < self.auto_confirm_delay_s:
                 return False
             log.warning(
                 "reshape of %s to %r confirmed WITHOUT a registry — "
@@ -207,7 +220,10 @@ class SliceReshaper:
         if raw is None:
             return False
         try:
-            return float(raw) >= p.requested_at
+            # Cross-process comparison: the agent publishes WALL time, so
+            # this one stays on the wall clock (monotonic clocks share no
+            # epoch across processes).
+            return float(raw) >= p.requested_wall
         except ValueError:
             return False
 

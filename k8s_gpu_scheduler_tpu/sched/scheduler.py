@@ -21,14 +21,13 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
-import time
-
 from ..api.objects import LABEL_POD_GROUP, Pod
 from ..cluster.apiserver import APIServer
 from ..cluster.informers import SharedInformerFactory
 from ..cluster.resources import Descriptor
 from ..config import SchedulerConfig
 from ..metrics.exporter import Registry
+from ..obs import SYSTEM_CLOCK
 from .cache import Cache, NodeInfo
 from .framework import (
     CycleState,
@@ -66,8 +65,20 @@ class Scheduler:
         metrics: Optional[Registry] = None,
         elector=None,
         fault_injector=None,
+        tracer=None,
+        clock=None,
     ) -> None:
         self.config = config or SchedulerConfig()
+        # Observability (obs/): the injected clock is the one time source
+        # for every cycle/e2e duration (virtual time in tests); the tracer
+        # (None in production) records the control-plane half of the
+        # request lifecycle — sched_queue (first enqueue -> pop, backoff
+        # round-trips included), sched_cycle (Filter->Permit) and
+        # sched_bind — on the "sched" lane, rid = the pod name, so a
+        # serving caller that submits with trace_id=<pod name> gets one
+        # correlated scheduler->engine timeline.
+        self._clock = clock or SYSTEM_CLOCK
+        self._tracer = tracer
         # Chaos harness hook (testing/faults.py): ``sched.cycle`` fires at
         # the top of every scheduling cycle — an injected drop unwinds the
         # cycle exactly like any plugin failure (the pod requeues with
@@ -106,6 +117,7 @@ class Scheduler:
         self.queue = SchedulingQueue(
             backoff_initial_s=self.config.backoff_initial_s,
             backoff_max_s=self.config.backoff_max_s,
+            clock=self._clock,
         )
         self.profile = profile or Profile()
         self.handle = Handle(self.factory, self.descriptor, self.cache, self.config)
@@ -266,12 +278,27 @@ class Scheduler:
 
         if self._faults is not None:
             self._faults.fire("sched.cycle")
+        if self._tracer is not None:
+            # Queue wait ends where the cycle begins; t0 is the FIRST
+            # enqueue (backoff round-trips count toward the wait — the
+            # number an SLO investigation needs).
+            now = self._clock.monotonic()
+            t0 = self.queue.enqueued_at(pod.metadata.uid)
+            self._tracer.record("sched_queue", t0 if t0 is not None
+                                else now, now, lane="sched",
+                                rid=pod.metadata.name)
         state = CycleState()
-        state.write("cycle_start", time.perf_counter())
+        state.write("cycle_start", self._clock.monotonic())
         try:
             self._run_cycle(state, pod)
         finally:
-            self._m_cycle.observe(time.perf_counter() - state.read("cycle_start"))
+            dt = self._clock.monotonic() - state.read("cycle_start")
+            self._m_cycle.observe(dt)
+            if self._tracer is not None:
+                self._tracer.record(
+                    "sched_cycle", state.read("cycle_start"),
+                    state.read("cycle_start") + dt, lane="sched",
+                    rid=pod.metadata.name)
 
     def _run_cycle(self, state: CycleState, pod: Pod) -> None:
         for pl in self.profile.pre_filter:
@@ -483,6 +510,7 @@ class Scheduler:
         self._bind(state, wp.pod, wp.node_name)
 
     def _bind(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        t_bind = self._clock.monotonic()
         try:
             self.descriptor.bind_pod(pod.metadata.name, pod.metadata.namespace, node_name)
         except Exception as e:  # noqa: BLE001
@@ -493,9 +521,13 @@ class Scheduler:
         self.handle.nominator.clear(pod.metadata.uid)
         self.queue.done(pod)
         self._m_attempts.inc(result="scheduled")
+        if self._tracer is not None:
+            self._tracer.record("sched_bind", t_bind,
+                                self._clock.monotonic(), lane="sched",
+                                rid=pod.metadata.name, node=node_name)
         start = state.read("cycle_start")
         if start is not None:
-            dt = time.perf_counter() - start
+            dt = self._clock.monotonic() - start
             self._m_e2e.observe(dt)
             self._m_e2e_class[pod_class(pod)].observe(dt)
         with self._fail_mu:

@@ -1,0 +1,45 @@
+"""Seeded-bad fixture: trace-in-jit true positives.
+
+Reintroducing this file into the scanned tree must fail
+``python -m k8s_gpu_scheduler_tpu.analysis`` (and ``--fast``): it puts
+obs/ span-API calls inside jit-traced bodies — the host-sync hazard the
+``trace-in-jit`` rule exists to catch. A span opened inside a traced
+function runs ONCE at trace time: the compiled program replays the
+trace-time "duration" forever (a lie), and any tracer attr built from a
+traced value concretizes mid-program. The production shape this rule
+demands lives in models/serving.py: every span times the HOST side of a
+dispatch, never the traced body.
+"""
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_scheduler_tpu.obs import Tracer
+
+tracer = Tracer()
+
+
+@jax.jit
+def traced_decode_step(x):
+    # WRONG: span context manager inside a jit body — evaluated at trace
+    # time only; the "timing" is a constant baked into the program.
+    with tracer.span("decode_chunk", lane="engine"):
+        y = jnp.tanh(x) * 2.0
+    return y
+
+
+def traced_via_wrapper(x, flight_recorder):
+    def body(v):
+        # WRONG: flight-recorder append inside a scanned body — a host
+        # list mutation during tracing records one phantom step.
+        flight_recorder.record("decode", tokens=1)
+        return v * 0.5, None
+
+    out, _ = jax.lax.scan(lambda c, _: body(c), x, None, length=4)
+    return out
+
+
+@jax.jit
+def traced_verify_step(x):
+    # WRONG: explicit record()/event() inside a jit body — same class.
+    tracer.event("rewind", lane="engine", rewound=2)
+    return x + 1
