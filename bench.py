@@ -1562,6 +1562,159 @@ def bench_obs_overhead(smoke=False):
     }
 
 
+def bench_fleet(smoke=False):
+    """Fleet-serving leg — the cache-aware router (fleet/router.py)
+    measured against round-robin placement on the SAME open-loop
+    Poisson trace: K hot system prompts (the shared-prefix workload of
+    the prefix-cache leg) arrive across 3 paged replicas; the affinity
+    policy must beat round-robin on aggregate prefix-hit rate (the CI
+    assert), a forced mid-trace shed must migrate in-flight requests to
+    the coldest replica, and EVERY stream — migrated or not, either
+    policy — must be byte-equal to a single-engine reference run
+    (greedy streams are placement-independent; the fleet must not
+    change answers, only where they compute). Reports fleet tok/s,
+    per-class TTFT p50, both hit rates, and the migration count. On CPU
+    (or --smoke) the model is tiny/f32; the TPU run under the driver is
+    what BENCH_*.json captures."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_scheduler_tpu.fleet import FleetError, Router
+    from k8s_gpu_scheduler_tpu.metrics.exporter import (
+        FLEET_MIGRATED_TOTAL, FLEET_ROUTED_TOTAL, Registry,
+    )
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if smoke or not on_tpu:
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+        n_req, max_new, rate = 30, 10, 1.5
+        eng_kw = dict(n_slots=4, max_len=96, chunk=4, prefill_bucket=16,
+                      kv_layout="paged", page_size=8, prefix_cache=True)
+    else:
+        cfg = LlamaConfig(
+            vocab=32000, d_model=1024, n_layers=4, n_heads=16,
+            n_kv_heads=16, d_ff=4096, max_seq=2048, remat=False,
+            decode_attn="fused")
+        n_req, max_new, rate = 96, 48, 2.0
+        eng_kw = dict(n_slots=8, max_len=2048, chunk=8,
+                      prefill_bucket=128, kv_layout="paged", page_size=64,
+                      kv_dtype="int8", prefix_cache=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    n_replicas, n_classes = 3, 3
+    page = eng_kw["page_size"]
+    rng = np.random.default_rng(0)
+    hot = [list(rng.integers(0, cfg.vocab, 2 * page))
+           for _ in range(n_classes)]
+    # Random class order: a round-robin class schedule would let the
+    # round-robin BASELINE partition classes onto replicas by accident.
+    classes = [int(c) for c in rng.integers(0, n_classes, n_req)]
+    workload = [hot[c] + list(rng.integers(0, cfg.vocab, 3 + i % 7))
+                for i, c in enumerate(classes)]
+    # One Poisson arrival schedule (in router-step units) for BOTH
+    # policies — the comparison is placement, not traffic.
+    arrive_step = np.floor(np.cumsum(
+        rng.exponential(1.0 / rate, n_req))).astype(int)
+
+    def engines():
+        return [(f"r{i}", ContinuousBatcher(params, cfg, **eng_kw))
+                for i in range(n_replicas)]
+
+    # Single-engine reference: greedy streams do not depend on where
+    # they decode, so one engine's answers are every placement's truth.
+    ref_eng = ContinuousBatcher(params, cfg, **eng_kw)
+    ids = [ref_eng.submit(p, max_new=max_new) for p in workload]
+    ref_done = {}
+    while ref_eng.pending:
+        ref_done.update(ref_eng.step())
+    ref = [ref_done[i] for i in ids]
+
+    def drive(policy, shed_at=None):
+        """Run the trace through a fresh fleet; returns (streams in
+        submit order, router, migrated count, wall seconds)."""
+        reg = Registry()
+        router = Router(engines(), policy=policy, metrics=reg)
+        frids, done, migrated = [], {}, 0
+        nxt, t = 0, 0
+        t0 = time.perf_counter()
+        while nxt < n_req or router.pending:
+            while nxt < n_req and arrive_step[nxt] <= t:
+                frids.append(router.submit(workload[nxt],
+                                           max_new=max_new))
+                nxt += 1
+            done.update(router.step())
+            if shed_at is not None and nxt >= shed_at and migrated == 0:
+                stats = {r: rep.engine.replica_stats()
+                         for r, rep in router._replicas.items()}
+                src = max(stats,
+                          key=lambda r: (stats[r]["active_slots"], r))
+                dst = min(stats,
+                          key=lambda r: (stats[r]["active_slots"], r))
+                if src != dst and stats[src]["active_slots"] > 1:
+                    try:
+                        migrated = router.shed(src, dst)
+                    except FleetError:
+                        pass        # target tight this instant: retry
+            t += 1
+        wall = time.perf_counter() - t0
+        streams = [done[f] for f in frids]
+        return streams, router, reg, migrated, wall
+
+    aff, aff_router, aff_reg, migrated, aff_wall = drive(
+        "affinity", shed_at=n_req // 2)
+    rr, rr_router, _, _, rr_wall = drive("round_robin")
+
+    aff_stats = aff_router.stats()
+    rr_stats = rr_router.stats()
+    # Per-class TTFT over the affinity run (the metrics drained during
+    # step() ride the router's fleet-id records).
+    met = aff_router.pop_request_metrics()
+    ttft_by_class = {c: [] for c in range(n_classes)}
+    for frid, m in met.items():
+        ttft_by_class[classes[frid]].append(m["ttft_s"] * 1e3)
+    ttft_p50 = {f"class{c}": round(_pctl(v, 0.50), 2) if v else None
+                for c, v in ttft_by_class.items()}
+
+    n_tok = sum(len(s) for s in aff)
+    extra = {
+        "fleet_shape": f"{n_replicas} replicas, {n_req} reqs over "
+                       f"{n_classes} hot {2 * page}-tok prompts, "
+                       f"max_new {max_new}, Poisson rate {rate}/step",
+        "fleet_interpret": not on_tpu,
+        "fleet_tok_s": round(n_tok / aff_wall, 1),
+        "fleet_rr_tok_s": round(n_tok / rr_wall, 1),
+        "fleet_prefix_hit_rate": round(
+            aff_stats["aggregate_prefix_hit_rate"], 4),
+        "fleet_rr_prefix_hit_rate": round(
+            rr_stats["aggregate_prefix_hit_rate"], 4),
+        "fleet_hit_beats_rr": (aff_stats["aggregate_prefix_hit_rate"]
+                               > rr_stats["aggregate_prefix_hit_rate"]),
+        "fleet_token_identity": aff == ref and rr == ref,
+        "fleet_migrated_requests": migrated,
+        "fleet_degraded_routes": aff_stats["degraded_routes"],
+        "fleet_ttft_p50_ms": ttft_p50,
+        "fleet_routed_total": sum(
+            aff_reg.counter(FLEET_ROUTED_TOTAL).value(
+                replica=f"r{i}", policy=p)
+            for i in range(n_replicas)
+            for p in ("affinity", "degraded")),
+        "fleet_migrated_total": sum(
+            aff_reg.counter(FLEET_MIGRATED_TOTAL).value(
+                replica=f"r{i}") for i in range(n_replicas)),
+    }
+    return {
+        "metric": "fleet_bench",
+        "value": extra["fleet_prefix_hit_rate"],
+        "unit": "hit_rate",
+        "extra": extra,
+    }
+
+
 def main(argv=None):
     args = list(sys.argv[1:] if argv is None else argv)
     if "--leg" in args:
@@ -1593,9 +1746,13 @@ def main(argv=None):
         if leg == "obs_overhead":
             print(json.dumps(bench_obs_overhead(smoke="--smoke" in args)))
             return
+        if leg == "fleet":
+            print(json.dumps(bench_fleet(smoke="--smoke" in args)))
+            return
         raise SystemExit(f"unknown bench leg: {leg!r} (available: "
                          f"decode_attention, paged_attention, prefix_cache, "
-                         f"speculative, analysis, chaos, obs_overhead)")
+                         f"speculative, analysis, chaos, obs_overhead, "
+                         f"fleet)")
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
     # the bench measures the scheduler as deployed.
     sys.setswitchinterval(0.001)

@@ -1,0 +1,18 @@
+"""Fleet serving tier: cache-aware routing + snapshot load shedding
+over N ``ContinuousBatcher`` replicas (see router.py / summary.py)."""
+from .router import FleetError, Router
+from .summary import (
+    MemoryStore, ReplicaSummary, list_summaries, prefix_match_len,
+    publish_summary, summarize,
+)
+
+__all__ = [
+    "FleetError",
+    "MemoryStore",
+    "ReplicaSummary",
+    "Router",
+    "list_summaries",
+    "prefix_match_len",
+    "publish_summary",
+    "summarize",
+]

@@ -1,0 +1,478 @@
+"""Cache-aware fleet router — N serving replicas behind one admission
+point.
+
+One paged ``ContinuousBatcher`` is a replica, not a service; this module
+is the fleet tier the ROADMAP's "millions of users" story needs. The
+``Router`` fronts N in-process engine replicas and places each request
+by SGLang-style cache-aware load balancing: every replica publishes a
+:class:`~.summary.ReplicaSummary` (radix digest + pool watermarks +
+per-phase p50s) into the registry, and admission scores
+
+    score(replica) = (1 + prefix_match_len(prompt, digest))
+                     × (eps + free_page_frac)
+                     × (eps + free_slot_frac)
+                     × 1 / (1 + decode_p50 / p50_ref)
+
+taking the argmax with a deterministic tiebreak (lowest replica id —
+same summaries, same placement, always). The match term routes shared
+system prompts to the replica that already holds their KV (prefill cost
+scales with the novel suffix — PR 4); the load terms keep a cold cache
+from losing every request to a hot one; the latency term is the
+DistServe observation that decode-phase pressure (TPOT) is the thing
+co-placement hurts, so it is scored per-phase rather than folded into a
+scalar load average. When summaries are STALE (an unreachable registry,
+a wedged publisher — the bounded-retry clients of utils/retry.py fail
+fast rather than hang) routing degrades to deterministic round-robin:
+worse placement, zero additional risk.
+
+The second half is LOAD SHEDDING: ``shed()`` takes a partial
+``ServingSnapshot`` off a hot replica (``drain(slots=...)`` — a filter
+over ``slot_req``, not a new format) and ``absorb()``s it into a cold
+one, token-identically, re-pointing the router's fleet-level request
+ids through the returned rid mapping. Both engines' flight recorders
+log the handoff (``shed``/``absorb`` records), and
+``assert_consistent`` holds on both pools afterwards.
+
+Threading: the router is a single-threaded driver (one step loop owns
+all N engines — the same model the per-engine step loop already uses);
+the concurrent surface is the registry, whose client is thread-safe and
+retry-bounded on its own.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..metrics.exporter import (
+    FLEET_AFFINITY_HITS_TOTAL, FLEET_COUNTERS, FLEET_MIGRATED_TOTAL,
+    FLEET_ROUTED_TOTAL, FLEET_SHED_TOTAL, export_serving_pool,
+)
+from ..models.snapshot import SnapshotError, check_fingerprint
+from ..obs import SYSTEM_CLOCK
+from .summary import (
+    MemoryStore, ReplicaSummary, list_summaries, prefix_match_len,
+    publish_summary, summarize,
+)
+
+# Phases feeding the routing p50s (the names _obs_span records).
+_DECODE_PHASES = ("decode_chunk", "verify")
+_PREFILL_PHASES = ("prefill",)
+
+
+class FleetError(RuntimeError):
+    """Fleet-level misuse or impossible operation (unknown replica,
+    shed without capacity, heterogeneous fleet)."""
+
+
+def _p50(window) -> float:
+    if not window:
+        return 0.0
+    xs = sorted(window)
+    return xs[len(xs) // 2]
+
+
+class _Replica:
+    """Router-side state for one engine: identity, publish seq, and the
+    rolling phase-duration windows the summary p50s are computed from
+    (fed by the same ``pool_metrics()`` phase batch the Prometheus
+    export consumes — drained once, used twice)."""
+
+    def __init__(self, replica_id: str, engine) -> None:
+        self.id = replica_id
+        self.engine = engine
+        self.seq = 0
+        self.decode_window: deque = deque(maxlen=256)
+        self.prefill_window: deque = deque(maxlen=64)
+
+
+class Router:
+    """Admission front for N in-process paged engine replicas.
+
+    ``replicas`` is a sequence of ``(id, ContinuousBatcher)`` pairs (ids
+    unique; engines paged with one shared page_size — scoring compares
+    page-aligned match lengths across them). ``store`` is the summary
+    plane: any object with the registry client's get/set/get_keys
+    (+mget) subset — defaults to an in-process :class:`MemoryStore`;
+    pass the real registry ``Client`` to share summaries across
+    processes. ``policy`` is ``"affinity"`` (cache-aware scoring, the
+    point of this module) or ``"round_robin"`` (the baseline the bench
+    leg beats). ``metrics`` is an optional metrics.exporter ``Registry``
+    — when present every replica's ``pool_metrics()`` exports under a
+    ``{replica=}`` label and the ``tpu_fleet_*`` counters are kept.
+    """
+
+    def __init__(self, replicas: Sequence[Tuple[str, object]],
+                 store=None, fleet: str = "fleet",
+                 policy: str = "affinity", stale_s: float = 5.0,
+                 clock=None, tracer=None, metrics=None,
+                 digest_top_k: int = 8, digest_max_tokens: int = 512,
+                 p50_ref_s: float = 0.05, load_eps: float = 0.1,
+                 auto_shed: bool = False,
+                 shed_free_frac: float = 0.125,
+                 shed_target_free_frac: float = 0.5) -> None:
+        if not replicas:
+            raise FleetError("a fleet needs at least one replica")
+        if policy not in ("affinity", "round_robin"):
+            raise FleetError(
+                f"policy must be 'affinity' or 'round_robin', got "
+                f"{policy!r}")
+        self._replicas: "OrderedDict[str, _Replica]" = OrderedDict()
+        first_id: Optional[str] = None
+        for rid, eng in replicas:
+            rid = str(rid)
+            if rid in self._replicas:
+                raise FleetError(f"duplicate replica id {rid!r}")
+            eng.replica_stats()          # paged-layout gate, fails early
+            if first_id is None:
+                first_id = rid
+            else:
+                # Fingerprint compatibility is validated HERE, not at
+                # shed time: a partial drain removes the shed slots
+                # from the source BEFORE absorb() runs its own
+                # fingerprint check, so a mismatched pair discovered
+                # mid-shed would strand the drained requests. With a
+                # homogeneous fleet (everything but n_pages must
+                # match — snapshot.check_fingerprint), absorb can only
+                # refuse for capacity, which shed() prechecks.
+                try:
+                    check_fingerprint(
+                        self._replicas[first_id].engine.fingerprint(),
+                        eng.fingerprint())
+                except SnapshotError as e:
+                    raise FleetError(
+                        f"replica {rid!r} is not shed-compatible with "
+                        f"{first_id!r}: {e}") from e
+            self._replicas[rid] = _Replica(rid, eng)
+        self.page_size = int(
+            self._replicas[first_id].engine.replica_stats()["page_size"])
+        self.fleet = str(fleet)
+        self.policy = policy
+        self.stale_s = float(stale_s)
+        self._store = store if store is not None else MemoryStore()
+        self._clock = clock or SYSTEM_CLOCK
+        self._tracer = tracer
+        self._metrics = metrics
+        self.digest_top_k = int(digest_top_k)
+        self.digest_max_tokens = int(digest_max_tokens)
+        self.p50_ref_s = float(p50_ref_s)
+        self.load_eps = float(load_eps)
+        self.auto_shed = bool(auto_shed)
+        self.shed_free_frac = float(shed_free_frac)
+        self.shed_target_free_frac = float(shed_target_free_frac)
+        if metrics is not None:
+            self._c_routed = metrics.counter(
+                FLEET_ROUTED_TOTAL, FLEET_COUNTERS[FLEET_ROUTED_TOTAL])
+            self._c_shed = metrics.counter(
+                FLEET_SHED_TOTAL, FLEET_COUNTERS[FLEET_SHED_TOTAL])
+            self._c_migrated = metrics.counter(
+                FLEET_MIGRATED_TOTAL, FLEET_COUNTERS[FLEET_MIGRATED_TOTAL])
+            self._c_affinity = metrics.counter(
+                FLEET_AFFINITY_HITS_TOTAL,
+                FLEET_COUNTERS[FLEET_AFFINITY_HITS_TOTAL])
+        # Fleet-level request ids: one namespace over all replicas —
+        # local engine ids are replica-private and CHANGE on migration
+        # (absorb assigns fresh ones), so callers hold fleet ids and the
+        # router re-points the mapping at each shed.
+        self._next_frid = 0
+        self._where: Dict[int, Tuple[str, int]] = {}   # frid -> (rid, lrid)
+        self._local: Dict[Tuple[str, int], int] = {}   # (rid, lrid) -> frid
+        self._req_metrics: Dict[int, Dict[str, float]] = {}
+        self._rr = 0                                   # round-robin cursor
+        self._degraded = 0                             # degraded routes
+        self._store_errors = 0
+        # Parsed-summary cache, valid for one publish cycle: routing a
+        # burst of submits between steps re-reads/re-parses nothing —
+        # publish() (the only writer this router knows about)
+        # invalidates it, so a shared-registry peer's update is picked
+        # up at the next publish boundary at the latest.
+        self._summaries_cache: Optional[Dict[str, ReplicaSummary]] = None
+        self.publish()                                 # summaries exist
+
+    # -- summary plane -----------------------------------------------------
+    def publish(self, replica_id: Optional[str] = None) -> None:
+        """Publish summaries (one replica, or the whole fleet): drain
+        each engine's ``pool_metrics()`` once — feeding the rolling
+        phase windows AND, when a metrics registry is attached, the
+        ``{replica=}``-labeled Prometheus export — then write the
+        summary to the store. Store failures are counted and swallowed:
+        the registry client is retry-bounded, and an unreachable
+        summary plane must degrade routing, never kill serving."""
+        reps = ([self._replica(replica_id)] if replica_id is not None
+                else list(self._replicas.values()))
+        for rep in reps:
+            pm = rep.engine.pool_metrics()
+            for phase, seconds in pm.get("phase_durations") or ():
+                if phase in _DECODE_PHASES:
+                    rep.decode_window.append(float(seconds))
+                elif phase in _PREFILL_PHASES:
+                    rep.prefill_window.append(float(seconds))
+            if self._metrics is not None:
+                export_serving_pool(self._metrics, pm,
+                                    labels={"replica": rep.id})
+            rep.seq += 1
+            s = summarize(
+                rep.engine, rep.id, fleet=self.fleet, seq=rep.seq,
+                now_wall=self._clock.wall(),
+                decode_p50_s=_p50(rep.decode_window),
+                prefill_p50_s=_p50(rep.prefill_window),
+                top_k=self.digest_top_k,
+                max_tokens=self.digest_max_tokens)
+            try:
+                publish_summary(self._store, s)
+            except Exception:  # noqa: BLE001 — summary plane down ≠ serving down
+                self._store_errors += 1
+        self._summaries_cache = None       # next route() re-reads once
+
+    def summaries(self) -> Dict[str, ReplicaSummary]:
+        """Summaries for THIS fleet's known replicas, from the store
+        (an empty dict when the store is unreachable — the caller's
+        staleness check then degrades routing). Cached per publish
+        cycle: the store is read/parsed once per step, not once per
+        submit."""
+        if self._summaries_cache is not None:
+            return dict(self._summaries_cache)
+        try:
+            listed = list_summaries(self._store, self.fleet)
+        except Exception:  # noqa: BLE001 — summary plane down ≠ serving down
+            self._store_errors += 1
+            return {}
+        out = {r: s for r, s in listed.items() if r in self._replicas}
+        self._summaries_cache = out
+        return dict(out)
+
+    # -- scoring -----------------------------------------------------------
+    def score(self, summary: ReplicaSummary,
+              prompt: Sequence[int]) -> Tuple[float, int]:
+        """(score, prefix match tokens) for placing ``prompt`` on the
+        summarized replica — a pure function of its arguments, which is
+        what makes placement deterministic and testable."""
+        match = prefix_match_len(prompt, summary.digest, self.page_size)
+        eps = self.load_eps
+        load = ((eps + summary.free_frac)
+                * (eps + summary.free_slot_frac)
+                / (1.0 + summary.decode_p50_s / self.p50_ref_s))
+        return (1.0 + match) * load, match
+
+    def route(self, prompt: Sequence[int]) -> Tuple[str, str, int]:
+        """Choose a replica for ``prompt``: returns
+        ``(replica id, policy used, prefix match tokens)``. Affinity
+        scoring needs FRESH summaries (published within ``stale_s`` of
+        now); with none fresh — or under ``policy="round_robin"`` — the
+        deterministic round-robin fallback places the request instead
+        (bounded staleness can degrade placement quality, never
+        correctness)."""
+        if self.policy == "affinity":
+            now = self._clock.wall()
+            fresh = {r: s for r, s in self.summaries().items()
+                     if now - s.published_wall <= self.stale_s}
+            if fresh:
+                best_rid, best_score, best_match = None, 0.0, 0
+                for rid in sorted(fresh):
+                    sc, match = self.score(fresh[rid], prompt)
+                    if best_rid is None or sc > best_score:
+                        best_rid, best_score, best_match = rid, sc, match
+                return best_rid, "affinity", best_match
+            self._degraded += 1
+        ids = list(self._replicas)
+        rid = ids[self._rr % len(ids)]
+        self._rr += 1
+        return rid, ("round_robin" if self.policy == "round_robin"
+                     else "degraded"), 0
+
+    # -- serving API -------------------------------------------------------
+    def submit(self, prompt, max_new: int,
+               trace_id: Optional[str] = None) -> int:
+        """Route and admit one request; returns its FLEET id (stable
+        across migrations — local engine ids are not)."""
+        prompt = [int(t) for t in prompt]
+        rid, policy, match = self.route(prompt)
+        eng = self._replica(rid).engine
+        lrid = eng.submit(prompt, max_new=max_new, trace_id=trace_id)
+        frid = self._next_frid
+        self._next_frid += 1
+        self._where[frid] = (rid, lrid)
+        self._local[(rid, lrid)] = frid
+        if self._metrics is not None:
+            self._c_routed.inc(replica=rid, policy=policy)
+            if match:
+                self._c_affinity.inc(replica=rid)
+        if self._tracer is not None:
+            self._tracer.event(
+                "route", lane="router",
+                rid=trace_id if trace_id is not None else f"fleet-{frid}",
+                replica=rid, policy=policy, match_tokens=match)
+        return frid
+
+    def locate(self, frid: int) -> Tuple[str, int]:
+        """(replica id, local request id) a fleet request currently
+        lives on — moves when a shed migrates it."""
+        if frid not in self._where:
+            raise FleetError(f"unknown or finished fleet request {frid}")
+        return self._where[frid]
+
+    @property
+    def pending(self) -> int:
+        return sum(r.engine.pending for r in self._replicas.values())
+
+    def step(self) -> Dict[int, list]:
+        """Step every replica once (admission + one decode/verify chunk
+        each), refresh the published summaries, and return the newly
+        finished streams keyed by FLEET id. With ``auto_shed`` on, a
+        replica past the pressure watermark sheds toward the coldest
+        peer after the step."""
+        done: Dict[int, list] = {}
+        for rep in self._replicas.values():
+            if not rep.engine.pending:
+                continue
+            finished = rep.engine.step()
+            metrics = rep.engine.pop_request_metrics()
+            for lrid, toks in finished.items():
+                frid = self._local.pop((rep.id, lrid), None)
+                if frid is None:
+                    continue                 # not router-owned (warmup)
+                self._where.pop(frid, None)
+                done[frid] = toks
+                if lrid in metrics:
+                    self._req_metrics[frid] = metrics[lrid]
+        self.publish()
+        if self.auto_shed:
+            self.maybe_shed()
+        return done
+
+    def run(self) -> Dict[int, list]:
+        """Drain everything submitted across the fleet."""
+        done: Dict[int, list] = {}
+        while self.pending:
+            done.update(self.step())
+        return done
+
+    def pop_request_metrics(self) -> Dict[int, Dict[str, float]]:
+        """Per-request latency records (ttft_s/latency_s/tokens) keyed
+        by fleet id, drained since the last call — migration-safe: a
+        shed request's record closes on the replica that finished it,
+        with the handoff gap charged (absorb rebases the clocks)."""
+        out, self._req_metrics = self._req_metrics, {}
+        return out
+
+    # -- load shedding -----------------------------------------------------
+    def _replica(self, rid: str) -> _Replica:
+        try:
+            return self._replicas[str(rid)]
+        except KeyError:
+            raise FleetError(f"unknown replica {rid!r}") from None
+
+    def shed(self, src: str, dst: str,
+             slots: Optional[List[int]] = None,
+             max_slots: Optional[int] = None) -> int:
+        """Migrate active slots from replica ``src`` to ``dst``: partial
+        ``drain(slots=...)`` → ``absorb()``, token-identically, with the
+        fleet-id mapping re-pointed. Default slot choice is the first
+        half of the active slots (sorted ids — deterministic); capacity
+        is prechecked on the target (free slots AND free pages) so the
+        shed either moves everything or moves nothing. Returns the
+        number of migrated requests."""
+        if str(src) == str(dst):
+            raise FleetError("shed needs two distinct replicas")
+        se, de = self._replica(src).engine, self._replica(dst).engine
+        active = se.active_slot_ids()
+        if slots is None:
+            n = max(1, len(active) // 2)
+            if max_slots is not None:
+                n = min(n, int(max_slots))
+            slots = active[:n]
+        slots = sorted(int(s) for s in slots)
+        if not slots:
+            return 0
+        dst_stats = de.replica_stats()
+        free_slots = dst_stats["n_slots"] - dst_stats["active_slots"]
+        need_pages = se.pages_referenced(slots)
+        if len(slots) > free_slots or need_pages > dst_stats["pages_free"]:
+            # Refuse up front: a drain the target cannot absorb would
+            # strand the shed requests (they leave the source engine
+            # with the snapshot). Tree-only pages on the target are
+            # reclaimable, but the conservative check keeps shed
+            # all-or-nothing without peeking into the peer's cache.
+            raise FleetError(
+                f"target {dst!r} cannot absorb {len(slots)} slots / "
+                f"{need_pages} pages (free: {free_slots} slots, "
+                f"{dst_stats['pages_free']} pages)")
+        t0 = self._clock.monotonic()
+        snap = se.drain(slots=slots)
+        if self._metrics is not None:
+            self._c_shed.inc(len(snap.slot_req), replica=str(src))
+        mapping = de.absorb(snap)
+        moved = 0
+        for (rid, lrid), frid in list(self._local.items()):
+            if rid == str(src) and lrid in mapping:
+                del self._local[(rid, lrid)]
+                new_key = (str(dst), mapping[lrid])
+                self._local[new_key] = frid
+                self._where[frid] = new_key
+                moved += 1
+        if self._metrics is not None:
+            self._c_migrated.inc(len(mapping), replica=str(dst))
+        if self._tracer is not None:
+            self._tracer.record(
+                "fleet_shed", t0, self._clock.monotonic(), lane="router",
+                src=str(src), dst=str(dst), slots=len(slots),
+                requests=len(mapping))
+        self.publish(str(src))
+        self.publish(str(dst))
+        return len(mapping)
+
+    def maybe_shed(self) -> int:
+        """Pressure-driven shed: when some replica's free-page fraction
+        is below ``shed_free_frac`` and another's is above
+        ``shed_target_free_frac``, move half the hot replica's active
+        slots to the coldest peer (deterministic tiebreak by id).
+        Returns migrated requests (0 when no pair qualifies or the
+        conservative capacity precheck refuses)."""
+        stats = {rid: rep.engine.replica_stats()
+                 for rid, rep in self._replicas.items()}
+
+        def frac(st):
+            return st["pages_free"] / st["pages_total"] \
+                if st["pages_total"] else 0.0
+
+        hot = [r for r in sorted(stats)
+               if frac(stats[r]) < self.shed_free_frac
+               and stats[r]["active_slots"] > 1]
+        cold = [r for r in sorted(stats)
+                if frac(stats[r]) > self.shed_target_free_frac]
+        if not hot or not cold:
+            return 0
+        src = min(hot, key=lambda r: (frac(stats[r]), r))
+        dst = max(cold, key=lambda r: (frac(stats[r]), r))
+        if src == dst:
+            return 0
+        try:
+            return self.shed(src, dst)
+        except FleetError:
+            return 0                 # no capacity this step; retry later
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> Dict[str, object]:
+        """Router-level counters + per-replica aggregate prefix stats —
+        what the fleet bench leg reports."""
+        per = {}
+        hit = looked = 0.0
+        for rid, rep in self._replicas.items():
+            pm = rep.engine.pool_metrics()
+            hit += pm.get("prefix_hit_tokens", 0.0)
+            looked += pm.get("prefix_lookup_tokens", 0.0)
+            per[rid] = {
+                "pages_free": pm.get("pages_free", 0.0),
+                "active_slots": len(rep.engine.active_slot_ids()),
+                "prefix_hit_tokens": pm.get("prefix_hit_tokens", 0.0),
+                "prefix_lookup_tokens": pm.get("prefix_lookup_tokens",
+                                               0.0),
+                "requests_shed_total": pm.get("requests_shed_total", 0.0),
+                "requests_resumed_total": pm.get("requests_resumed_total",
+                                                 0.0),
+            }
+        return {
+            "replicas": per,
+            "aggregate_prefix_hit_rate": hit / looked if looked else 0.0,
+            "degraded_routes": self._degraded,
+            "store_errors": self._store_errors,
+        }

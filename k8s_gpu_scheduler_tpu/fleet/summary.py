@@ -1,0 +1,195 @@
+"""Replica state summaries — the routing substrate of the fleet tier.
+
+A serving replica (one paged ``ContinuousBatcher``) periodically
+publishes a compact summary of the two things a cache-aware router
+needs: WHAT it has cached (a radix-tree digest — the top-K hottest
+cached token-prefix paths, models/prefix_cache.py ``digest()``) and HOW
+LOADED it is (free-page watermark, active slots, queue depth, recent
+per-phase latency p50s drawn from the same ``pool_metrics()`` /
+``tpu_serve_phase_duration_seconds`` plumbing the Prometheus exporter
+consumes). The summary rides the registry under
+``replica/<fleet>/<id>`` (registry/inventory.py key layout) exactly the
+way node inventories do — the serving-tier analogue of the reference's
+profiler writing GPU-UUID lists per node and the scheduler listing them
+back (gpu_plugins.go:536-542): writer and reader share one typed schema
+defined here once, and the lister uses the same chunked-MGET pattern
+``list_inventories`` grew at fleet scale.
+
+``prefix_match_len`` is the router's scoring primitive: an estimate of
+how many prompt tokens a replica would serve from its cache, computed
+AGAINST THE DIGEST — page-aligned and capped one page below full cover,
+mirroring ``PrefixCache.match``'s contract (admission always leaves the
+last page to prefill), so the score predicts exactly the prefill rows
+admission will actually skip. Truncated digest paths under-claim, never
+over-claim.
+
+``MemoryStore`` is the in-process registry stand-in (the
+get/set/get_keys/mget subset of registry/client.py's ``Client``): a
+single-process fleet — the bench, the tests, a dev loop — needs no
+kvstored to route, while production passes the real RESP client and the
+summaries ride the shared registry. Chaos tests wrap either in a
+``FaultProxy`` to flap the summary plane and drive the router's
+degraded path.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..registry.inventory import REPLICA_KEY_PREFIX, replica_key
+
+
+@dataclass
+class ReplicaSummary:
+    """One replica's published state: identity + seq/wall for staleness,
+    pool watermarks + slot occupancy for load, per-phase p50s for the
+    DistServe-style pressure split (decode p50 = TPOT pressure, prefill
+    p50 = TTFT pressure), and the cache digest for prefix affinity."""
+
+    replica: str
+    fleet: str = "fleet"
+    seq: int = 0
+    published_wall: float = 0.0        # Clock.wall() — crosses processes
+    page_size: int = 1
+    pages_total: int = 0
+    pages_free: int = 0
+    n_slots: int = 0
+    active_slots: int = 0
+    queued: int = 0
+    decode_p50_s: float = 0.0
+    prefill_p50_s: float = 0.0
+    # [(token path, full cached token length)], hottest first.
+    digest: List[Tuple[List[int], int]] = field(default_factory=list)
+
+    def to_json(self) -> str:
+        d = asdict(self)
+        d["digest"] = [[list(map(int, t)), int(n)] for t, n in self.digest]
+        return json.dumps(d, sort_keys=True)
+
+    @staticmethod
+    def from_json(raw: str) -> "ReplicaSummary":
+        d = json.loads(raw)
+        digest = [(list(map(int, t)), int(n))
+                  for t, n in d.pop("digest", [])]
+        return ReplicaSummary(digest=digest, **d)
+
+    @property
+    def free_frac(self) -> float:
+        return self.pages_free / self.pages_total if self.pages_total \
+            else 0.0
+
+    @property
+    def free_slot_frac(self) -> float:
+        return (1.0 - self.active_slots / self.n_slots) if self.n_slots \
+            else 0.0
+
+
+def summarize(engine, replica: str, fleet: str = "fleet", seq: int = 0,
+              now_wall: float = 0.0, decode_p50_s: float = 0.0,
+              prefill_p50_s: float = 0.0, top_k: int = 8,
+              max_tokens: int = 512) -> ReplicaSummary:
+    """Build a summary from a live engine's ``replica_stats()`` +
+    ``cache_digest()`` (both cheap host reads — no device sync). The
+    phase p50s come from the CALLER (the router keeps rolling windows
+    over the ``pool_metrics()`` phase batches it already drains for the
+    Prometheus export, so summarize never steals the batch)."""
+    st = engine.replica_stats()
+    return ReplicaSummary(
+        replica=replica, fleet=fleet, seq=seq, published_wall=now_wall,
+        page_size=int(st["page_size"]), pages_total=int(st["pages_total"]),
+        pages_free=int(st["pages_free"]), n_slots=int(st["n_slots"]),
+        active_slots=int(st["active_slots"]), queued=int(st["queued"]),
+        decode_p50_s=float(decode_p50_s),
+        prefill_p50_s=float(prefill_p50_s),
+        digest=engine.cache_digest(top_k, max_tokens),
+    )
+
+
+def prefix_match_len(prompt: Sequence[int],
+                     digest: Sequence[Tuple[Sequence[int], int]],
+                     page_size: int) -> int:
+    """Cached-prefix tokens a replica with this digest would serve for
+    ``prompt``: the longest common token prefix against any digest path,
+    floored to page granularity and capped so at least the prompt's last
+    page prefills — the exact shape of ``PrefixCache.match``'s answer,
+    predicted from the digest alone."""
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    best = 0
+    for tokens, cached_len in digest:
+        m = 0
+        for a, b in zip(prompt, tokens):
+            if int(a) != int(b):
+                break
+            m += 1
+        best = max(best, min(m, int(cached_len)))
+    pages = best // page_size
+    if pages and pages * page_size == len(prompt):
+        pages -= 1                   # the last page always re-prefills
+    return pages * page_size
+
+
+def publish_summary(client, summary: ReplicaSummary) -> None:
+    """Replica-side write (the profiler-client pattern, typed)."""
+    client.set(replica_key(summary.fleet, summary.replica),
+               summary.to_json())
+
+
+def list_summaries(client, fleet: str) -> Dict[str, ReplicaSummary]:
+    """Router-side listing: one chunked MGET per 512 replicas (the
+    ``list_inventories`` pattern — kvstored's RESP reader caps a command
+    at 1024 array elements). Unparseable values are skipped, not
+    fatal — one corrupt writer must not blind the router to the rest of
+    the fleet."""
+    keys = client.get_keys(f"{REPLICA_KEY_PREFIX}{fleet}/*")
+    if not keys:
+        return {}
+    mget = getattr(client, "mget", None)
+    if callable(mget):
+        values: List[Optional[str]] = []
+        for i in range(0, len(keys), 512):
+            values.extend(mget(*keys[i:i + 512]))
+    else:
+        values = [client.get(k) for k in keys]
+    out: Dict[str, ReplicaSummary] = {}
+    for raw in values:
+        if raw is None:
+            continue
+        try:
+            s = ReplicaSummary.from_json(raw)
+        except (ValueError, TypeError, KeyError):
+            continue
+        if s.fleet == fleet:
+            out[s.replica] = s
+    return out
+
+
+class MemoryStore:
+    """Dict-backed stand-in for the registry ``Client`` subset the fleet
+    uses (get/set/get_keys/mget/delete) — the in-process default so a
+    single-binary fleet routes without a kvstored; swap in the real RESP
+    client for a shared multi-process registry. No locking: the router
+    drives it from one thread, and the real concurrent store is the
+    registry server itself."""
+
+    def __init__(self) -> None:
+        self._kv: Dict[str, str] = {}
+
+    def set(self, key: str, value: str) -> None:
+        self._kv[key] = str(value)
+
+    def get(self, key: str) -> Optional[str]:
+        return self._kv.get(key)
+
+    def mget(self, *keys: str) -> List[Optional[str]]:
+        return [self._kv.get(k) for k in keys]
+
+    def get_keys(self, pattern: str = "*") -> List[str]:
+        if pattern.endswith("*"):
+            pre = pattern[:-1]
+            return sorted(k for k in self._kv if k.startswith(pre))
+        return sorted(k for k in self._kv if k == pattern)
+
+    def delete(self, *keys: str) -> int:
+        return sum(1 for k in keys if self._kv.pop(k, None) is not None)

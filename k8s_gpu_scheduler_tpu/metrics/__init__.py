@@ -15,8 +15,10 @@ from .client import (
     parse_response,
 )
 from .exporter import (
-    Counter, Gauge, Histogram, MetricsServer, PHASE_BUCKETS,
-    PHASE_HISTOGRAM, Registry, SERVING_POOL_GAUGES, export_serving_pool,
+    Counter, FLEET_AFFINITY_HITS_TOTAL, FLEET_COUNTERS,
+    FLEET_MIGRATED_TOTAL, FLEET_ROUTED_TOTAL, FLEET_SHED_TOTAL, Gauge,
+    Histogram, MetricsServer, PHASE_BUCKETS, PHASE_HISTOGRAM, Registry,
+    SERVING_POOL_GAUGES, export_serving_pool,
 )
 
 __all__ = [
@@ -31,6 +33,11 @@ __all__ = [
     "TPU_SERIES",
     "parse_response",
     "Counter",
+    "FLEET_AFFINITY_HITS_TOTAL",
+    "FLEET_COUNTERS",
+    "FLEET_MIGRATED_TOTAL",
+    "FLEET_ROUTED_TOTAL",
+    "FLEET_SHED_TOTAL",
     "Gauge",
     "Histogram",
     "MetricsServer",

@@ -251,7 +251,9 @@ SERVING_POOL_GAUGES = {
     "restore_duration_seconds":
         "wall time of the last snapshot restore (re-layout + scatter)",
     "requests_resumed_total":
-        "interrupted requests resumed by the last restore",
+        "interrupted requests resumed by restore/absorb on this engine",
+    "requests_shed_total":
+        "requests shed to a peer replica via partial drain (fleet tier)",
     "request_errors_total":
         "poison requests failed in isolation (step loop error containment)",
     "last_step_age_seconds":
@@ -271,7 +273,8 @@ PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
 
 
 def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
-                        prefix: str = "tpu_serve_") -> None:
+                        prefix: str = "tpu_serve_",
+                        labels: Optional[Dict[str, str]] = None) -> None:
     """Publish a ``ContinuousBatcher.pool_metrics()`` snapshot as gauges
     (``tpu_serve_page_utilization``, ``tpu_serve_prefix_hit_rate``, ...).
     Keys absent from the snapshot (contiguous layout → {}, prefix cache
@@ -282,10 +285,20 @@ def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
     attached) is a drained-once batch of ``(phase, seconds)`` pairs from
     the same lock snapshot as the gauges; it folds into the
     ``tpu_serve_phase_duration_seconds{phase=...}`` histogram rather
-    than a gauge — durations are a distribution, not a level."""
+    than a gauge — durations are a distribution, not a level.
+
+    ``labels`` stamps every gauge value and phase observation with a
+    constant label set — the fleet tier publishes each replica under
+    ``{replica="r0"}`` so one scrape shows N engines side by side
+    (Gauge/Histogram per-label-set series, the same machinery the
+    ``phase=`` label rides). ``labels=None`` (every pre-fleet caller)
+    writes the unlabeled series — the text exposition stays
+    byte-identical."""
+    labels = labels or {}
     for key, help_ in SERVING_POOL_GAUGES.items():
         if key in pool_metrics:
-            registry.gauge(prefix + key, help_).set(pool_metrics[key])
+            registry.gauge(prefix + key, help_).set(
+                pool_metrics[key], **labels)
     phases = pool_metrics.get("phase_durations") or ()
     if phases:
         hist = registry.histogram(
@@ -294,7 +307,28 @@ def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
             "decode_chunk|verify|rewind|reap), by phase",
             buckets=PHASE_BUCKETS)
         for phase, seconds in phases:
-            hist.observe(float(seconds), phase=str(phase))
+            hist.observe(float(seconds), phase=str(phase), **labels)
+
+
+# Fleet-router counters (fleet/router.py increments these; the names are
+# the metrics contract the README documents). ``routed`` carries
+# {replica=, policy=} — policy "affinity" (cache-aware scoring) vs
+# "degraded" (stale/unreachable summaries → round-robin).
+FLEET_ROUTED_TOTAL = "tpu_fleet_routed_requests_total"
+FLEET_SHED_TOTAL = "tpu_fleet_shed_requests_total"
+FLEET_MIGRATED_TOTAL = "tpu_fleet_migrated_requests_total"
+FLEET_AFFINITY_HITS_TOTAL = "tpu_fleet_prefix_affinity_hits_total"
+FLEET_COUNTERS = {
+    FLEET_ROUTED_TOTAL:
+        "requests admitted through the fleet router, by replica/policy",
+    FLEET_SHED_TOTAL:
+        "requests shed out of a hot replica (partial drain), by source",
+    FLEET_MIGRATED_TOTAL:
+        "shed requests successfully absorbed, by target replica",
+    FLEET_AFFINITY_HITS_TOTAL:
+        "routed requests whose chosen replica had a non-zero cached "
+        "prefix match",
+}
 
 
 class MetricsServer:

@@ -1,0 +1,124 @@
+"""Serve-entrypoint preemption lifecycle: SIGTERM → drain → persist →
+resume.
+
+PR 6 built the engine half (``ContinuousBatcher.drain()`` →
+``ServingSnapshot`` → ``restore()``, token-identical); this module is
+the ENTRYPOINT half the ROADMAP left open: GKE delivers SIGTERM ~30 s
+before a spot reclaim — far more than the measured drain cost — so the
+serve loop (models/llama.py) installs :class:`PreemptionGuard`, checks
+it between waves, and on a request drains to the pod volume through
+``utils/checkpoint.py``'s orbax machinery; the replacement pod's boot
+calls :func:`resume_or_fresh` (the serving analogue of
+``TrainCheckpointer.restore_or``) and every interrupted stream resumes
+token-identically. The chaos harness drives the same helpers with a
+``testing/faults.py`` ``Preempted`` injection instead of a real signal
+— one code path, two triggers.
+"""
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Callable, Optional, Tuple
+
+from .snapshot import ServingSnapshot
+
+log = logging.getLogger(__name__)
+
+# Serving snapshots are singular (a drained engine has exactly one
+# state), but the step id must still ADVANCE per drain: orbax's
+# ``force=`` does not overwrite an existing step (StepAlreadyExists on
+# the second preemption of a pod lineage), so each persist writes
+# ``latest + 1`` and ``max_to_keep=1`` prunes the predecessor.
+SNAPSHOT_STEP = 0
+
+
+class PreemptionGuard:
+    """SIGTERM-to-drain bridge for a serve loop. The handler only SETS
+    an event — signal handlers run between bytecodes on the main
+    thread, and draining from inside one would re-enter a step
+    mid-flight; the serve loop polls ``requested`` at its wave boundary
+    (seconds, versus the ~30 s GKE grace window) and runs the drain
+    itself. ``request()`` is the programmatic trigger the chaos tests
+    and the ``Preempted``-exception path use."""
+
+    def __init__(self, signum: int = signal.SIGTERM) -> None:
+        self._event = threading.Event()
+        self._signum = signum
+        self._prev = None
+        self._installed = False
+
+    def install(self) -> "PreemptionGuard":
+        """Register the handler (main thread only — a CPython
+        constraint on ``signal.signal``); keeps the previous handler
+        for ``uninstall``."""
+        self._prev = signal.signal(self._signum, self._on_signal)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            signal.signal(self._signum, self._prev or signal.SIG_DFL)
+            self._installed = False
+
+    def _on_signal(self, signum, frame) -> None:
+        self._event.set()
+
+    def request(self) -> None:
+        self._event.set()
+
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+
+def persist_snapshot(snap: ServingSnapshot, directory: str) -> None:
+    """Write a drained snapshot under ``directory`` via the orbax
+    checkpointer (``to_pytree`` makes it StandardSave-compatible);
+    blocks until the async save lands — the process is about to exit."""
+    from ..utils.checkpoint import TrainCheckpointer
+
+    with TrainCheckpointer(directory, max_to_keep=1) as ckpt:
+        latest = ckpt.latest_step()
+        step = SNAPSHOT_STEP if latest is None else latest + 1
+        ckpt.save(step, snap.to_pytree(), force=True)
+
+
+def drain_to_checkpoint(engine, directory: str) -> ServingSnapshot:
+    """The SIGTERM handler's action: drain the engine (admission stops,
+    every referenced page gathers to host) and persist the snapshot.
+    Returns it so the caller can log what was saved."""
+    snap = engine.drain()
+    persist_snapshot(snap, directory)
+    log.info("drained %d in-flight request(s) to %s",
+             snap.n_requests_in_flight, directory)
+    return snap
+
+
+def load_snapshot(directory: str) -> Optional[ServingSnapshot]:
+    """Latest persisted serving snapshot under ``directory``, or None
+    when there is none (first boot)."""
+    from ..utils.checkpoint import TrainCheckpointer
+
+    with TrainCheckpointer(directory, max_to_keep=1) as ckpt:
+        if ckpt.latest_step() is None:
+            return None
+        return ServingSnapshot.from_pytree(ckpt.restore())
+
+
+def resume_or_fresh(make_engine: Callable[[], object],
+                    directory: Optional[str]) -> Tuple[object, int]:
+    """``restore_or`` for serving: build a fresh engine and, when
+    ``directory`` holds a snapshot, restore it — the replacement pod
+    resumes every interrupted stream token-identically, with the
+    preemption downtime charged to the latency records (snapshot clock
+    re-basing). Returns ``(engine, resumed request count)``."""
+    eng = make_engine()
+    if not directory:
+        return eng, 0
+    snap = load_snapshot(directory)
+    if snap is None:
+        return eng, 0
+    resumed = eng.restore(snap)
+    log.info("resumed %d serving request(s) from %s", resumed, directory)
+    return eng, resumed

@@ -464,6 +464,9 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
 
         Tp, max_new = args.prompt_len, args.max_new
         if jax.process_count() == 1:
+            from .lifecycle import (
+                PreemptionGuard, drain_to_checkpoint, resume_or_fresh,
+            )
             from .serving import ContinuousBatcher
 
             sparams = params
@@ -472,11 +475,32 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
 
                 sparams = quantize_llama_params(params)
             n_slots = 8
-            eng = ContinuousBatcher(
-                sparams, cfg, n_slots=n_slots, max_len=cfg.max_seq,
-                chunk=max_new, prefill_bucket=max(Tp, 16), mesh=mesh,
-                eos_id=args.eos_id, temperature=args.temperature,
-                top_k=args.top_k)
+            # Paged is the preemption-safe production layout (drain/
+            # snapshot/restore is pool pages + block tables); the paged
+            # pool is single-chip for now, so a local mesh keeps the
+            # contiguous cache and skips the snapshot lifecycle.
+            layout = "paged" if mesh is None else "contiguous"
+
+            def mk_engine():
+                return ContinuousBatcher(
+                    sparams, cfg, n_slots=n_slots, max_len=cfg.max_seq,
+                    chunk=max_new, prefill_bucket=max(Tp, 16), mesh=mesh,
+                    eos_id=args.eos_id, temperature=args.temperature,
+                    top_k=args.top_k, kv_layout=layout)
+
+            # Preemption lifecycle (models/lifecycle.py): boot resumes
+            # the predecessor pod's drained snapshot when one exists on
+            # the volume (restore_or-style); SIGTERM — GKE sends it
+            # ~30 s before spot reclaim — requests a drain the wave
+            # boundary below honors.
+            snap_dir = (os.path.join(args.ckpt_dir, "serve_snapshot")
+                        if args.ckpt_dir and layout == "paged" else None)
+            eng, resumed = resume_or_fresh(mk_engine, snap_dir)
+            if resumed:
+                print(f"llama serve worker={worker_id} resumed "
+                      f"{resumed} in-flight requests from {snap_dir}",
+                      flush=True)
+            guard = PreemptionGuard().install()
             rng = _np.random.default_rng(0)
 
             def prompt_arr():
@@ -489,6 +513,16 @@ def main() -> None:  # pragma: no cover — the deploy/workloads entrypoint
             # p99 published seeds the registry latency EWMA verbatim.
             eng.pop_request_metrics()
             while True:
+                if guard.requested:
+                    # Drain at the wave boundary (never mid-step), save
+                    # to the pod volume, exit 0 — the replacement pod's
+                    # resume_or_fresh above finishes the streams.
+                    if snap_dir is not None:
+                        snap = drain_to_checkpoint(eng, snap_dir)
+                        print(f"llama serve worker={worker_id} drained "
+                              f"{snap.n_requests_in_flight} requests to "
+                              f"{snap_dir}", flush=True)
+                    raise SystemExit(0)
                 t0 = time.perf_counter()
                 n_req = 4 * n_slots
                 for _ in range(n_req):

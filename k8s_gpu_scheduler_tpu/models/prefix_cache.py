@@ -181,6 +181,26 @@ class PrefixCache:
                 freed += 1
         return freed
 
+    def digest(self, top_k: int = 8,
+               max_tokens: int = 512) -> List[Tuple[List[int], int]]:
+        """Compact routing digest: the ``top_k`` HOTTEST root-to-leaf
+        token paths (most-recent ``last_used`` first) as
+        ``(tokens, cached_len)`` pairs, each token list truncated to
+        ``max_tokens``. This is what a fleet replica publishes to the
+        registry so a cache-aware router (fleet/router.py) can score
+        ``prefix_match_len(prompt, digest)`` WITHOUT shipping the whole
+        tree: hot shared system prompts are short and few, so a handful
+        of truncated paths carries almost all the routing signal.
+        ``cached_len`` is the path's full cached token length (it can
+        exceed ``len(tokens)`` when truncated) — a match against a
+        truncated path scores at most ``max_tokens``, which only
+        under-claims, never over-claims, reuse."""
+        paths = self.dump_paths()                # coldest first
+        out: List[Tuple[List[int], int]] = []
+        for tokens, pages in reversed(paths[-top_k:] if top_k else []):
+            out.append((tokens[:max_tokens], len(pages) * self.page_size))
+        return out
+
     def dump_paths(self) -> List[Tuple[List[int], List[int]]]:
         """The tree as root-to-LEAF ``(tokens, pages)`` paths, ordered by
         the leaf's LRU clock (coldest first) — the serializable form a

@@ -1062,6 +1062,7 @@ class ContinuousBatcher:
         self._drain_s: Optional[float] = None
         self._restore_s: Optional[float] = None
         self._resumed = 0
+        self._shed_total = 0                 # requests shed to a peer
         self._request_errors = 0
         self.errors: Dict[int, str] = {}
         # Watchdog/liveness: monotonic timestamp of the last step start —
@@ -2128,7 +2129,7 @@ class ContinuousBatcher:
             fp["n_pages"] = self._alloc.n_pages
         return fp
 
-    def drain(self) -> ServingSnapshot:
+    def drain(self, slots: Optional[list] = None) -> ServingSnapshot:
         """Stop admission and serialize the whole in-flight state machine
         to host: the preemption path's first half (the SIGTERM handler
         calls this, persists the snapshot through utils/checkpoint.py,
@@ -2143,16 +2144,36 @@ class ContinuousBatcher:
         radix tree as token-keyed paths. Speculative proposals are
         deliberately NOT captured — they are a pure function of
         prompt + emitted stream and are re-proposed after restore.
-        The engine refuses further submit/step afterwards."""
+        The engine refuses further submit/step afterwards.
+
+        ``slots`` selects a PARTIAL drain — the load-shedding half of
+        the fleet tier (fleet/router.py): only the named active slots'
+        pages and bookkeeping ship (a filter over ``slot_req`` — same
+        format, no queue, no prefix tree), the snapshot is marked
+        ``partial`` for ``absorb()`` on the target replica, and THIS
+        engine keeps serving — the shed slots retire through the normal
+        reap path (their full-prompt pages donate into the local prefix
+        tree, the rest free immediately), so shedding both relieves
+        page pressure and leaves the hot prefix cached."""
         if self.layout != "paged":
             raise SnapshotError(
                 "drain() requires kv_layout='paged' (the snapshot format "
                 "is pool pages + block tables)")
         if self._drained:
             raise RuntimeError("engine already drained")
+        partial = slots is not None
+        if partial:
+            slots = sorted(int(s) for s in slots)
+            if not slots:
+                raise ValueError("partial drain needs at least one slot")
+            missing = [s for s in slots if s not in self._slot_req]
+            if missing:
+                raise ValueError(
+                    f"cannot shed inactive slot(s) {missing}: only active "
+                    f"slots carry migratable requests")
         t0 = self._clock.monotonic()
         self._flush()
-        if self._chaos_pages:                # chaos hostages are not state
+        if not partial and self._chaos_pages:  # chaos hostages are not state
             self._alloc.free(self._chaos_pages)
             self._chaos_pages = []
         ids: list = []
@@ -2165,11 +2186,12 @@ class ContinuousBatcher:
                     seen.add(p)
                     ids.append(p)
 
-        for slot in sorted(self._slot_req):
+        shed = slots if partial else sorted(self._slot_req)
+        for slot in shed:
             add(self._slot_shared.get(slot, ()))
             add(self._slot_pages.get(slot, ()))
         tree_paths = (self._prefix.dump_paths()
-                      if self._prefix is not None else [])
+                      if self._prefix is not None and not partial else [])
         for _, pages in tree_paths:
             add(pages)
 
@@ -2189,7 +2211,7 @@ class ContinuousBatcher:
                              for _ in range(2)]
         # graftcheck: ignore[host-sync] — sanctioned: drain-time readback of two [n_slots] vectors
         lens, last = jax.device_get((self._lens, self._last))
-        if self._flight is not None:
+        if self._flight is not None and not partial:
             # Recorded BEFORE the payload dump so the drain marker itself
             # rides the snapshot: the restored ring then reads
             # ...decode, drain, restore... across the process boundary.
@@ -2198,6 +2220,23 @@ class ContinuousBatcher:
                 in_flight=len(self._slot_req), queued=len(self._queue),
                 wall_ms=round(
                     (self._clock.monotonic() - t0) * 1e3, 3))
+        shed_set = set(shed)
+        shed_rids = {int(self._slot_req[s]) for s in shed_set} \
+            if partial else None
+        if partial:
+            # Table rows of slots that stay MUST NOT ride: their pages
+            # are not shipped, and restore/absorb LUT-remaps every row.
+            table = np.full_like(self._table_np, NULL_PAGE)
+            table[shed] = self._table_np[shed]
+        else:
+            table = self._table_np.copy()
+
+        def keep_slot(s):
+            return not partial or int(s) in shed_set
+
+        def keep_rid(r):
+            return not partial or int(r) in shed_rids
+
         snap = ServingSnapshot(
             fingerprint=self.fingerprint(),
             page_ids=ids,
@@ -2207,34 +2246,71 @@ class ContinuousBatcher:
                       if self._ks is not None else None),
             v_scales=(np.asarray(gathered[3])
                       if self._ks is not None else None),
-            table=self._table_np.copy(),
+            table=table,
             lens=np.asarray(lens, np.int32),
             last=np.asarray(last, np.int32),
-            slot_req={int(s): int(r) for s, r in self._slot_req.items()},
+            slot_req={int(s): int(r) for s, r in self._slot_req.items()
+                      if keep_slot(s)},
             slot_pages={int(s): [int(p) for p in pg]
-                        for s, pg in self._slot_pages.items()},
+                        for s, pg in self._slot_pages.items()
+                        if keep_slot(s)},
             slot_shared={int(s): [int(p) for p in pg]
-                         for s, pg in self._slot_shared.items()},
+                         for s, pg in self._slot_shared.items()
+                         if keep_slot(s)},
             slot_prompt={int(s): [int(t) for t in pr]
-                         for s, pr in self._slot_prompt.items()},
-            budgets={int(r): int(b) for r, b in self._budget.items()},
+                         for s, pr in self._slot_prompt.items()
+                         if keep_slot(s)},
+            budgets={int(r): int(b) for r, b in self._budget.items()
+                     if keep_rid(r)},
             out={int(r): [int(t) for t in ts]
-                 for r, ts in self._out.items()},
-            queue=[(int(r), [int(t) for t in pr])
-                   for r, pr in self._queue],
-            next_id=self._next_id,
+                 for r, ts in self._out.items() if keep_rid(r)},
+            queue=[] if partial else [(int(r), [int(t) for t in pr])
+                                     for r, pr in self._queue],
+            next_id=0 if partial else self._next_id,
             eos_scanned={int(r): int(n)
-                         for r, n in self._eos_scanned.items()},
+                         for r, n in self._eos_scanned.items()
+                         if keep_rid(r)},
             tree_paths=tree_paths,
-            arrival=dict(self._arrival),
-            first_tok=dict(self._first_tok),
+            arrival={r: t for r, t in self._arrival.items()
+                     if keep_rid(r)},
+            first_tok={r: t for r, t in self._first_tok.items()
+                       if keep_rid(r)},
             drained_mono=self._clock.monotonic(),
             drained_wall=self._clock.wall(),
-            skipped_tokens=self._skipped_tokens,
-            flight=(self._flight.to_payload()
-                    if self._flight is not None else []),
+            skipped_tokens=0 if partial else self._skipped_tokens,
+            flight=([] if partial or self._flight is None
+                    else self._flight.to_payload()),
+            partial=partial,
         )
         snap.validate()
+        if partial:
+            # The shed slots leave THROUGH the reap path: full-prompt
+            # pages donate into the local tree (the prefix stays warm
+            # here too — it is reclaimable capacity, evicted on
+            # demand), everything else frees now. The request-level
+            # bookkeeping migrates with the snapshot.
+            self._shed_total += len(shed)
+            for slot in shed:
+                rid = self._slot_req.pop(slot)
+                self._budget.pop(rid, None)
+                self._out.pop(rid, None)
+                self._eos_scanned.pop(rid, None)
+                self._arrival.pop(rid, None)
+                self._first_tok.pop(rid, None)
+                if self.spec:
+                    self._spec_mirror.pop(slot, None)
+                self._free_slot_pages(slot)
+            if self._flight is not None:
+                self._flight.record(
+                    "shed", slots=len(shed), pages=len(ids),
+                    requests=len(snap.slot_req),
+                    pool_free=self._alloc.free_count,
+                    wall_ms=round(
+                        (self._clock.monotonic() - t0) * 1e3, 3))
+            if self._tracer is not None:
+                self._obs_span("shed", t0, self._clock.monotonic(),
+                               slots=len(shed), pages=len(ids))
+            return snap
         self._drained = True
         self._drain_s = self._clock.monotonic() - t0
         if self._tracer is not None:
@@ -2270,32 +2346,14 @@ class ContinuousBatcher:
             raise SnapshotError(
                 "restore() needs a FRESH engine (no admitted slots, no "
                 "queue, no allocated pages)")
+        if snap.partial:
+            raise SnapshotError(
+                "partial snapshot (a shed slot subset): absorb() it into "
+                "a running replica; restore() rebuilds a whole engine")
         check_fingerprint(snap.fingerprint, self.fingerprint())
         snap.validate()
         t0 = self._clock.monotonic()
-        new = self._alloc.alloc(len(snap.page_ids))
-        if new is None:
-            raise SnapshotError(
-                f"snapshot references {len(snap.page_ids)} pages but the "
-                f"pool has only {self._alloc.free_count} free")
-        lut = np.full(max(snap.page_ids, default=0) + 1, -1, np.int64)
-        lut[NULL_PAGE] = NULL_PAGE
-        for old, nw in zip(snap.page_ids, new):
-            lut[old] = nw
-        if new:
-            idx = np.asarray(new, np.int32)
-            self._k = self._k.at[:, idx].set(
-                jnp.asarray(snap.k_pages, self._k.dtype))
-            self._v = self._v.at[:, idx].set(
-                jnp.asarray(snap.v_pages, self._v.dtype))
-            if self._ks is not None:
-                if snap.k_scales is None:
-                    raise SnapshotError(
-                        "int8-KV engine but snapshot has no scale planes")
-                self._ks = self._ks.at[:, idx].set(
-                    jnp.asarray(snap.k_scales, jnp.float32))
-                self._vs = self._vs.at[:, idx].set(
-                    jnp.asarray(snap.v_scales, jnp.float32))
+        lut = self._upload_snapshot_pages(snap)
         table = np.asarray(snap.table, np.int64)
         if table.shape != self._table_np.shape:
             raise SnapshotError(
@@ -2350,6 +2408,184 @@ class ContinuousBatcher:
                            resumed=self._resumed)
         return self._resumed
 
+    def _upload_snapshot_pages(self, snap: ServingSnapshot) -> np.ndarray:
+        """Shared restore/absorb page move: allocate fresh pages for the
+        snapshot's shipped ids (evicting tree-only pages on shortage
+        when a prefix cache is attached — reclaimable capacity, the
+        admission path's argument), scatter the KV bytes (+ int8 scale
+        planes) into them, and return the old→new LUT (-1 = unshipped,
+        null maps to null)."""
+        need = len(snap.page_ids)
+        if self._prefix is not None and need > self._alloc.free_count:
+            self._prefix.evict(need - self._alloc.free_count)
+        new = self._alloc.alloc(need)
+        if new is None:
+            raise SnapshotError(
+                f"snapshot references {need} pages but the pool has "
+                f"only {self._alloc.free_count} free")
+        lut = np.full(max(snap.page_ids, default=0) + 1, -1, np.int64)
+        lut[NULL_PAGE] = NULL_PAGE
+        for old, nw in zip(snap.page_ids, new):
+            lut[old] = nw
+        if new:
+            idx = np.asarray(new, np.int32)
+            self._k = self._k.at[:, idx].set(
+                jnp.asarray(snap.k_pages, self._k.dtype))
+            self._v = self._v.at[:, idx].set(
+                jnp.asarray(snap.v_pages, self._v.dtype))
+            if self._ks is not None:
+                if snap.k_scales is None:
+                    raise SnapshotError(
+                        "int8-KV engine but snapshot has no scale planes")
+                self._ks = self._ks.at[:, idx].set(
+                    jnp.asarray(snap.k_scales, jnp.float32))
+                self._vs = self._vs.at[:, idx].set(
+                    jnp.asarray(snap.v_scales, jnp.float32))
+        return lut
+
+    def absorb(self, snap: ServingSnapshot) -> Dict[int, int]:
+        """Merge a PARTIAL snapshot — ``drain(slots=...)`` on a hot peer
+        replica — into THIS **running** engine: the second half of fleet
+        load shedding (fleet/router.py). Unlike ``restore()``, the
+        target is busy, so nothing global transfers: each shed slot maps
+        onto a free local slot, its pages re-lay out through this
+        engine's allocator (LUT remap, exactly restore's move), and its
+        request gets a FRESH local id (the source's ids would collide
+        with ours) — the returned ``{old rid: new rid}`` mapping is how
+        the router re-points its bookkeeping. Pages the source mounted
+        READ-ONLY from its prefix tree arrive as slot-OWNED here (their
+        bytes shipped; the source tree kept its own copy) — a page two
+        shed slots both mounted allocates once and ``retain``s per
+        extra holder, so ``assert_consistent`` holds on both engines
+        after the handoff, and the normal reap donates the prefix into
+        THIS tree when the request finishes. Latency clocks rebase
+        across the hop (the migration gap is charged to the request).
+        Token identity is the same greedy guarantee restore makes: the
+        shipped pages hold exactly the bytes the slot's own prefill/
+        decode wrote, and decode resumes at the shipped ``lens``."""
+        if self.layout != "paged":
+            raise SnapshotError("absorb() requires kv_layout='paged'")
+        if self._drained:
+            raise RuntimeError("cannot absorb into a drained engine")
+        if not snap.partial:
+            raise SnapshotError(
+                "absorb() takes a PARTIAL snapshot (drain(slots=...)); "
+                "restore() a full snapshot into a fresh engine")
+        if snap.tree_paths:
+            raise SnapshotError(
+                "partial snapshot must not carry a prefix tree")
+        check_fingerprint(snap.fingerprint, self.fingerprint())
+        snap.validate()
+        free_slots = sorted(s for s in range(self.n_slots)
+                            if s not in self._slot_req)
+        if len(snap.slot_req) > len(free_slots):
+            raise SnapshotError(
+                f"snapshot carries {len(snap.slot_req)} slots but only "
+                f"{len(free_slots)} are free here")
+        t0 = self._clock.monotonic()
+        need = len(snap.page_ids)
+        lut = self._upload_snapshot_pages(snap)
+        now_m, now_w = self._clock.monotonic(), self._clock.wall()
+        arrival = snap.rebased_clock(snap.arrival, now_m, now_w)
+        first = snap.rebased_clock(snap.first_tok, now_m, now_w)
+        # graftcheck: ignore[host-sync] — sanctioned: absorb-time readback of two [n_slots] vectors (one migration, not a step-loop cost)
+        got = jax.device_get((self._lens, self._last))
+        lens, last = np.array(got[0]), np.array(got[1])  # writable copies
+        mapping: Dict[int, int] = {}
+        claimed: set = set()
+        for src_slot in sorted(snap.slot_req):
+            rid = int(snap.slot_req[src_slot])
+            tgt = free_slots.pop(0)
+            new_rid = self._next_id
+            self._next_id += 1
+            mapping[rid] = new_rid
+            row = np.asarray(snap.table[src_slot], np.int64)
+            if row.max(initial=0) >= len(lut) or (lut[row] < 0).any():
+                raise SnapshotError(
+                    "block table references pages the snapshot did not "
+                    "ship")
+            self._table_np[tgt] = lut[row].astype(np.int32)
+            pages = [int(lut[p])
+                     for p in (list(snap.slot_shared.get(src_slot, []))
+                               + list(snap.slot_pages.get(src_slot, [])))]
+            for p in pages:
+                if p in claimed:
+                    self._alloc.retain([p])
+                claimed.add(p)
+            self._slot_req[tgt] = new_rid
+            self._slot_pages[tgt] = pages
+            self._slot_shared[tgt] = []
+            self._slot_prompt[tgt] = [
+                int(t) for t in snap.slot_prompt[src_slot]]
+            self._budget[new_rid] = int(snap.budgets[rid])
+            self._out[new_rid] = [int(t) for t in snap.out.get(rid, [])]
+            if rid in snap.eos_scanned:
+                self._eos_scanned[new_rid] = int(snap.eos_scanned[rid])
+            if rid in arrival:
+                self._arrival[new_rid] = arrival[rid]
+            if rid in first:
+                self._first_tok[new_rid] = first[rid]
+            lens[tgt] = int(snap.lens[src_slot])
+            last[tgt] = int(snap.last[src_slot])
+        self._lens = jnp.asarray(lens, jnp.int32)
+        self._last = jnp.asarray(last, jnp.int32)
+        self._table_dirty = True
+        self._alloc.assert_consistent()
+        self._resumed += len(mapping)
+        if self._flight is not None:
+            self._flight.record(
+                "absorb", resumed=len(mapping), pages=need,
+                pool_free=self._alloc.free_count,
+                wall_ms=round((self._clock.monotonic() - t0) * 1e3, 3))
+        if self._tracer is not None:
+            self._obs_span("absorb", t0, self._clock.monotonic(),
+                           resumed=len(mapping), pages=need)
+        return mapping
+
+    # -- fleet-tier inputs (fleet/summary.py reads these) ------------------
+    def replica_stats(self) -> Dict[str, int]:
+        """Instantaneous load numbers a fleet replica publishes for
+        cache-aware routing — cheap host-side reads, no device sync."""
+        if self.layout != "paged":
+            raise ValueError(
+                "replica_stats() requires kv_layout='paged' (the fleet "
+                "tier routes on page watermarks)")
+        return {
+            "page_size": self.page_size,
+            "pages_total": self._alloc.n_pages - 1,
+            "pages_free": self._alloc.free_count,
+            "n_slots": self.n_slots,
+            "active_slots": len(self._slot_req),
+            "queued": len(self._queue),
+        }
+
+    def cache_digest(self, top_k: int = 8,
+                     max_tokens: int = 512) -> list:
+        """Routing digest of the radix prefix cache (the top-K hottest
+        cached token-prefix paths — models/prefix_cache.py digest());
+        [] when the cache is off."""
+        if self.layout != "paged" or self._prefix is None:
+            return []
+        return self._prefix.digest(top_k, max_tokens)
+
+    def active_slot_ids(self) -> list:
+        """Sorted slot ids currently bound to a request — the shed
+        candidates the router picks a partial drain from."""
+        return sorted(self._slot_req)
+
+    def pages_referenced(self, slots) -> int:
+        """Distinct non-null pages the given active slots reference
+        (own + mounted shared) — the router's shed-size precheck, so a
+        partial drain is only taken when the target verifiably has room
+        (an absorb failure after the drain would strand the shed
+        requests)."""
+        seen: set = set()
+        for s in slots:
+            seen.update(int(p) for p in self._slot_shared.get(s, ()))
+            seen.update(int(p) for p in self._slot_pages.get(s, ()))
+        seen.discard(NULL_PAGE)
+        return len(seen)
+
     def pool_metrics(self) -> Dict[str, object]:
         """Page-pool health (paged layout only; {} otherwise): total/free/
         in-use/cached/watermark page counts, alloc/free/denied churn, the
@@ -2374,6 +2610,7 @@ class ContinuousBatcher:
         out["drain_duration_seconds"] = self._drain_s or 0.0
         out["restore_duration_seconds"] = self._restore_s or 0.0
         out["requests_resumed_total"] = float(self._resumed)
+        out["requests_shed_total"] = float(self._shed_total)
         out["request_errors_total"] = float(self._request_errors)
         if self._prefix is not None:
             out.update(self._prefix.metrics())

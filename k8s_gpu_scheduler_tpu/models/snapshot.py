@@ -95,6 +95,13 @@ class ServingSnapshot:
     # restored engine's recorder so post-preemption debugging can see
     # pre-preemption behavior. Default [] keeps older snapshots loading.
     flight: List[Dict[str, Any]] = field(default_factory=list)
+    # PARTIAL snapshot (load shedding): a filter over ``slot_req`` —
+    # only the shed slots' pages/bookkeeping, no queue, no prefix tree,
+    # and the SOURCE engine keeps running. Consumed by
+    # ``ContinuousBatcher.absorb()`` (which merges into a BUSY engine);
+    # ``restore()`` rejects it — a partial snapshot is not a whole
+    # engine. Default False keeps older snapshots loading.
+    partial: bool = False
 
     # -- derived -----------------------------------------------------------
     @property
@@ -174,6 +181,14 @@ class ServingSnapshot:
             "drained_wall": float(self.drained_wall),
             "skipped_tokens": int(self.skipped_tokens),
             "flight": list(self.flight),
+            "partial": bool(self.partial),
+            # Payload geometry, so a ZERO-page snapshot (drain with all
+            # slots finished — only the queue ships) can omit its empty
+            # arrays from the pytree: orbax/tensorstore refuses to write
+            # zero-size params, and from_pytree rebuilds them from here.
+            "payload_shape": [int(x) for x in self.k_pages.shape],
+            "payload_dtype": str(np.asarray(self.k_pages).dtype),
+            "has_scales": self.k_scales is not None,
         }
 
     def to_pytree(self) -> Dict[str, np.ndarray]:
@@ -184,13 +199,16 @@ class ServingSnapshot:
         ).copy()
         tree: Dict[str, np.ndarray] = {
             "meta_json": meta,
-            "k_pages": np.asarray(self.k_pages),
-            "v_pages": np.asarray(self.v_pages),
             "table": np.asarray(self.table),
             "lens": np.asarray(self.lens),
             "last": np.asarray(self.last),
         }
-        if self.k_scales is not None:
+        # Zero-size payloads stay out of the pytree (orbax cannot write
+        # them); the meta doc's payload_shape/dtype rebuild them.
+        if np.asarray(self.k_pages).size:
+            tree["k_pages"] = np.asarray(self.k_pages)
+            tree["v_pages"] = np.asarray(self.v_pages)
+        if self.k_scales is not None and np.asarray(self.k_scales).size:
             tree["k_scales"] = np.asarray(self.k_scales)
             tree["v_scales"] = np.asarray(self.v_scales)
         return tree
@@ -204,15 +222,29 @@ class ServingSnapshot:
                 f"snapshot version {doc.get('version')} != "
                 f"{SNAPSHOT_VERSION}")
         pairs = lambda key: {k: v for k, v in doc[key]}  # noqa: E731
+        shape = tuple(doc.get("payload_shape", ()))
+        dtype = np.dtype(doc.get("payload_dtype", "float32"))
+        if "k_pages" in tree:
+            k_pages = np.asarray(tree["k_pages"])
+            v_pages = np.asarray(tree["v_pages"])
+        else:                    # zero-page snapshot: payload omitted
+            k_pages = np.zeros(shape, dtype)
+            v_pages = np.zeros(shape, dtype)
+        if "k_scales" in tree:
+            k_scales = np.asarray(tree["k_scales"])
+            v_scales = np.asarray(tree["v_scales"])
+        elif doc.get("has_scales", False):
+            k_scales = np.zeros(shape[:-1] + (1,), np.float32)
+            v_scales = np.zeros(shape[:-1] + (1,), np.float32)
+        else:
+            k_scales = v_scales = None
         snap = cls(
             fingerprint=doc["fingerprint"],
             page_ids=list(doc["page_ids"]),
-            k_pages=np.asarray(tree["k_pages"]),
-            v_pages=np.asarray(tree["v_pages"]),
-            k_scales=(np.asarray(tree["k_scales"])
-                      if "k_scales" in tree else None),
-            v_scales=(np.asarray(tree["v_scales"])
-                      if "v_scales" in tree else None),
+            k_pages=k_pages,
+            v_pages=v_pages,
+            k_scales=k_scales,
+            v_scales=v_scales,
             table=np.asarray(tree["table"]),
             lens=np.asarray(tree["lens"]),
             last=np.asarray(tree["last"]),
@@ -232,6 +264,7 @@ class ServingSnapshot:
             drained_wall=doc["drained_wall"],
             skipped_tokens=doc["skipped_tokens"],
             flight=list(doc.get("flight", [])),
+            partial=bool(doc.get("partial", False)),
         )
         snap.validate()
         return snap

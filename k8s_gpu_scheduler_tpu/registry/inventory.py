@@ -20,10 +20,20 @@ NODE_KEY_PREFIX = "node/"          # node/<name>   -> NodeInventory JSON
 HEARTBEAT_SUFFIX = "/heartbeat"    # node/<name>/heartbeat -> unix ts
 OBSERVED_KEY_PREFIX = "observed/"  # observed/<workload>/<column> -> Observation
 LATENCY_KEY_PREFIX = "latency/"    # latency/<workload>/<column> -> p99 ms
+REPLICA_KEY_PREFIX = "replica/"    # replica/<fleet>/<id> -> ReplicaSummary
 
 
 def node_key(node_name: str) -> str:
     return NODE_KEY_PREFIX + node_name
+
+
+def replica_key(fleet: str, replica: str) -> str:
+    """Serving-replica state summary published for the cache-aware
+    router (fleet/summary.py) — the serving-tier analogue of the
+    reference's per-node GPU-UUID keys: each replica writes its radix
+    digest + pool watermarks here, the router lists them with the same
+    chunked-MGET pattern ``list_inventories`` uses."""
+    return f"{REPLICA_KEY_PREFIX}{fleet}/{replica}"
 
 
 def latency_key(workload: str, column: str) -> str:

@@ -243,6 +243,54 @@ class TestServingPoolExport:
         # Every exported key is documented in the gauge map.
         assert set(snapshot) <= set(SERVING_POOL_GAUGES)
 
+    def test_replica_labeled_export_and_unlabeled_byte_identity(self):
+        """The fleet tier publishes each replica under {replica=}: the
+        labeled series ride the SAME gauges/histogram, and a caller
+        that passes no labels gets a text exposition byte-identical to
+        the pre-label format."""
+        from k8s_gpu_scheduler_tpu.metrics import export_serving_pool
+
+        snapshot = {"pages_free": 20.0, "page_utilization": 0.375,
+                    "phase_durations": (("decode_chunk", 0.004),)}
+        reg_plain = Registry()
+        export_serving_pool(reg_plain, dict(snapshot))
+        reg_plain2 = Registry()
+        export_serving_pool(reg_plain2, dict(snapshot), labels=None)
+        assert reg_plain.expose() == reg_plain2.expose()
+
+        reg = Registry()
+        export_serving_pool(reg, dict(snapshot),
+                            labels={"replica": "r0"})
+        export_serving_pool(reg, {"pages_free": 5.0},
+                            labels={"replica": "r1"})
+        text = reg.expose()
+        assert 'tpu_serve_pages_free{replica="r0"} 20.0' in text
+        assert 'tpu_serve_pages_free{replica="r1"} 5.0' in text
+        assert ('tpu_serve_phase_duration_seconds_count'
+                '{phase="decode_chunk",replica="r0"} 1') in text
+
+    def test_fleet_counters_catalogued_and_labeled(self):
+        """The router's tpu_fleet_* counters: every name in the catalog
+        carries help text, and the routed counter splits by
+        replica/policy."""
+        from k8s_gpu_scheduler_tpu.metrics.exporter import (
+            FLEET_COUNTERS, FLEET_ROUTED_TOTAL,
+        )
+
+        reg = Registry()
+        for name, help_ in FLEET_COUNTERS.items():
+            reg.counter(name, help_)
+        c = reg.counter(FLEET_ROUTED_TOTAL)
+        c.inc(replica="r0", policy="affinity")
+        c.inc(2, replica="r1", policy="degraded")
+        text = reg.expose()
+        for name in FLEET_COUNTERS:
+            assert f"# HELP {name}" in text
+        assert ('tpu_fleet_routed_requests_total'
+                '{policy="affinity",replica="r0"} 1.0') in text
+        assert ('tpu_fleet_routed_requests_total'
+                '{policy="degraded",replica="r1"} 2.0') in text
+
     def test_absent_keys_are_skipped(self):
         """Contiguous layout ({}) and prefix-cache-off snapshots publish
         what they have — unconditional per-step publishing is safe."""
