@@ -1715,6 +1715,203 @@ def bench_fleet(smoke=False):
     }
 
 
+def bench_chunked_prefill(smoke=False):
+    """Chunked-prefill leg — the TTFT/decode-interference contract of
+    ``ContinuousBatcher(prefill_chunk_tokens=...)``, measured: an
+    open-loop Poisson, decode-heavy short-request trace with LONG-PROMPT
+    arrivals injected mid-stream runs chunking-off and chunking-on over
+    the SAME schedule (step-indexed arrivals, so scheduling — and hence
+    the chunk/rung walk — is a pure function of the trace and the
+    second, measured pass retraces nothing). Chunking-off, the long
+    admission dispatches its whole prefill as one program and every
+    active decode slot stalls for it; chunking-on, each step spends at
+    most the token budget on prefill chunks before its decode chunk.
+    The CI step asserts: byte-identical streams, zero retraces across
+    the measured pass, a STRICTLY lower max decode-step stall with
+    chunking on, and short-request TTFT p99 no worse (1.1x headroom for
+    CPU wall jitter — the observed margin is several-fold the other
+    way). On CPU (or --smoke) the model is tiny/f32 with a 512-row rope
+    table so the injected prompt is genuinely long; the TPU run under
+    the driver is what BENCH_*.json captures."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_scheduler_tpu.analysis.recompile import RecompileGuard
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if smoke or not on_tpu:
+        # f32: the identity assert must see no bf16 near-tie noise.
+        cfg = dataclasses.replace(LlamaConfig(
+            vocab=256, d_model=64, n_layers=2, n_heads=8, n_kv_heads=8,
+            d_ff=128, max_seq=512, remat=False), dtype=jnp.float32)
+        n_short, short_p, short_new, rate = 16, 12, 32, 0.5
+        long_p, long_new, long_at = 320, 8, (5, 14)
+        budget = 48
+        eng_kw = dict(n_slots=8, max_len=512, chunk=4, prefill_bucket=16,
+                      page_size=16)
+    else:
+        cfg = LlamaConfig(
+            vocab=32000, d_model=1024, n_layers=4, n_heads=16,
+            n_kv_heads=16, d_ff=4096, max_seq=2048, remat=False,
+            decode_attn="fused")
+        n_short, short_p, short_new, rate = 48, 64, 64, 1.5
+        long_p, long_new, long_at = 1536, 16, (8, 28)
+        budget = 256
+        eng_kw = dict(n_slots=8, max_len=2048, chunk=8,
+                      prefill_bucket=128, page_size=64, kv_dtype="int8")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    # One step-indexed schedule for BOTH modes: shorts Poisson at
+    # ``rate``/step, longs injected while shorts are decoding, plus a
+    # deterministic BURST of shorts arriving with each long — the
+    # interference scenario the feature targets: chunking off, those
+    # shorts' first tokens sit behind the long's whole-prefill dispatch
+    # (the TTFT spike the serve_poisson_* p99s show); chunking on, their
+    # single-chunk prefills share the same steps' budgets with the
+    # long's quanta. Greedy streams depend only on prompts, so identity
+    # is schedule-exact.
+    arr = np.floor(np.cumsum(
+        rng.exponential(1.0 / rate, n_short))).astype(int)
+    sched = [(int(s), list(rng.integers(0, cfg.vocab, short_p)),
+              short_new, "short") for s in arr]
+    for ls in long_at:
+        sched.append((ls, list(rng.integers(0, cfg.vocab, long_p)),
+                      long_new, "long"))
+        for burst_step in (ls, ls + 1):
+            for _ in range(2):
+                sched.append((burst_step,
+                              list(rng.integers(0, cfg.vocab, short_p)),
+                              short_new, "short"))
+    sched.sort(key=lambda e: e[0])
+    n_short += 4 * len(long_at)          # the burst rides the short class
+
+    def drive(eng):
+        """One pass of the trace: per-step walls for steps that ran a
+        decode/verify dispatch (the decode-step stall series), streams
+        in submission order, latency records, peak prefill backlog."""
+        done, ids, stalls = {}, [], []
+        t = sub = 0
+        backlog_peak = 0.0
+        while sub < len(sched) or eng.pending:
+            while sub < len(sched) and sched[sub][0] <= t:
+                ids.append(eng.submit(sched[sub][1],
+                                      max_new=sched[sub][2]))
+                sub += 1
+            if eng.pending:
+                seq0 = eng._flight._seq
+                t0 = time.perf_counter()
+                done.update(eng.step())
+                wall = time.perf_counter() - t0
+                if any(r["seq"] >= seq0
+                       and r["kind"] in ("decode", "verify")
+                       for r in eng._flight.records()):
+                    stalls.append(wall * 1e3)
+                backlog_peak = max(backlog_peak, eng.pool_metrics().get(
+                    "prefill_backlog_tokens", 0.0))
+            t += 1
+        return ([done[i] for i in ids], stalls,
+                eng.pop_request_metrics(), ids, backlog_peak)
+
+    kinds = [e[3] for e in sched]
+    engines = {}
+    for mode, chunk_tokens in (("unchunked", None), ("chunked", budget)):
+        eng = ContinuousBatcher(params, cfg, kv_layout="paged",
+                                prefill_chunk_tokens=chunk_tokens,
+                                **eng_kw)
+        drive(eng)                   # warm pass: every rung compiles
+        guard = RecompileGuard()
+        guard.track("decode", eng._decode)
+        guard.track("prefill", eng._prefill)
+        guard.snapshot()
+        engines[mode] = (eng, guard)
+    # Interleaved best-of-N measured passes (the obs-leg pattern):
+    # machine drift hits both modes alike, and min() per mode takes
+    # each one's clean floor — the max-stall and tail-TTFT statistics
+    # are single-step-noise sensitive, the structural gap is not.
+    repeats = 2
+    passes = {m: [] for m in engines}
+    for _ in range(repeats):
+        for mode in ("unchunked", "chunked"):
+            streams, stalls, met, ids, backlog_peak = drive(
+                engines[mode][0])
+            ttft = {"short": [], "long": []}
+            for j, rid in enumerate(ids):
+                if rid in met:
+                    ttft[kinds[j]].append(met[rid]["ttft_s"] * 1e3)
+            passes[mode].append({
+                "streams": streams,
+                "max_stall": max(stalls),
+                "stall_p99": _pctl(stalls, 0.99),
+                "ttft_p50": _pctl(ttft["short"], 0.50),
+                "ttft_p99": _pctl(ttft["short"], 0.99),
+                "long_p50": _pctl(ttft["long"], 0.50),
+                "backlog_peak": backlog_peak,
+            })
+
+    def agg(mode):
+        ps = passes[mode]
+        eng, guard = engines[mode]
+        return {
+            "streams": ps[0]["streams"],
+            "same_streams": all(p["streams"] == ps[0]["streams"]
+                                for p in ps),
+            "max_stall": min(p["max_stall"] for p in ps),
+            "stall_p99": min(p["stall_p99"] for p in ps),
+            "ttft_p50": min(p["ttft_p50"] for p in ps),
+            "ttft_p99": min(p["ttft_p99"] for p in ps),
+            "long_p50": min(p["long_p50"] for p in ps),
+            "misses": guard.misses_since(),
+            "backlog_peak": max(p["backlog_peak"] for p in ps),
+            "chunks": eng.pool_metrics()["prefill_chunks_total"],
+        }
+
+    on, off = agg("chunked"), agg("unchunked")
+    extra = {
+        "chunked_prefill_shape": (
+            f"{n_short} shorts ({short_p} tok, max_new {short_new}) at "
+            f"{rate}/step + {len(long_at)} x {long_p}-tok longs, "
+            f"budget {budget}"),
+        "chunked_prefill_interpret": not on_tpu,
+        "chunked_prefill_passes": repeats,
+        "chunked_token_identity": (on["streams"] == off["streams"]
+                                   and on["same_streams"]
+                                   and off["same_streams"]),
+        "chunked_zero_retrace": not any(on["misses"].values()),
+        "unchunked_max_stall_ms": round(off["max_stall"], 1),
+        "chunked_max_stall_ms": round(on["max_stall"], 1),
+        "unchunked_stall_p99_ms": round(off["stall_p99"], 1),
+        "chunked_stall_p99_ms": round(on["stall_p99"], 1),
+        "unchunked_ttft_p50_ms": round(off["ttft_p50"], 1),
+        "chunked_ttft_p50_ms": round(on["ttft_p50"], 1),
+        "unchunked_ttft_p99_ms": round(off["ttft_p99"], 1),
+        "chunked_ttft_p99_ms": round(on["ttft_p99"], 1),
+        "unchunked_long_ttft_p50_ms": round(off["long_p50"], 1),
+        "chunked_long_ttft_p50_ms": round(on["long_p50"], 1),
+        "chunked_backlog_peak_tokens": on["backlog_peak"],
+        "chunked_prefill_chunks_total": on["chunks"],
+    }
+    extra["chunked_stall_win"] = (extra["chunked_max_stall_ms"]
+                                  < extra["unchunked_max_stall_ms"])
+    # 1.1x = CPU wall-jitter headroom on the no-worse bound; the
+    # observed margin is several-fold in chunking's favor.
+    extra["chunked_ttft_p99_ok"] = (extra["chunked_ttft_p99_ms"]
+                                    <= 1.1 * extra["unchunked_ttft_p99_ms"])
+    stall_ratio = (extra["unchunked_max_stall_ms"]
+                   / max(extra["chunked_max_stall_ms"], 1e-9))
+    return {
+        "metric": "chunked_prefill_stall_ratio",
+        "value": round(stall_ratio, 2),
+        "unit": "x",
+        "extra": extra,
+    }
+
+
 def main(argv=None):
     args = list(sys.argv[1:] if argv is None else argv)
     if "--leg" in args:
@@ -1749,10 +1946,13 @@ def main(argv=None):
         if leg == "fleet":
             print(json.dumps(bench_fleet(smoke="--smoke" in args)))
             return
+        if leg == "chunked_prefill":
+            print(json.dumps(bench_chunked_prefill(smoke="--smoke" in args)))
+            return
         raise SystemExit(f"unknown bench leg: {leg!r} (available: "
                          f"decode_attention, paged_attention, prefix_cache, "
                          f"speculative, analysis, chaos, obs_overhead, "
-                         f"fleet)")
+                         f"fleet, chunked_prefill)")
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
     # the bench measures the scheduler as deployed.
     sys.setswitchinterval(0.001)

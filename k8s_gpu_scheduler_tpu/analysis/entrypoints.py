@@ -312,6 +312,51 @@ def _paged_prefix_batcher_scenario() -> tuple:
     return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
 
 
+def _paged_chunked_batcher_scenario() -> tuple:
+    """Chunked-prefill edition of the paged scenario: a long prompt's
+    budgeted prefill CHUNKS interleave with live decode traffic across
+    every steady wave (the Sarathi-Serve schedule). Each chunk is a
+    (tb, hb) rung of the same prefill program family — hb grows as the
+    slot's own earlier chunks become the resident "hit" — so the whole
+    walk compiles once during warmup and steady-state mixed
+    prefill+decode must be ZERO retrace with the pool/table riding the
+    donation chain. Waves vary prompt lengths (same rungs), budget
+    contention (a short prompt waiting behind the long one's chunks)
+    and pure-prefill steps (no fully-prefilled slot -> no decode
+    dispatch)."""
+    import dataclasses
+
+    from ..models.serving import ContinuousBatcher
+
+    cfg, params = _tiny()
+    eng = ContinuousBatcher(params, dataclasses.replace(cfg,
+                                                        decode_attn="fused"),
+                            n_slots=2, max_len=32, chunk=2,
+                            prefill_bucket=8, kv_dtype="int8",
+                            kv_layout="paged", page_size=8,
+                            prefill_chunk_tokens=8)
+    rng = np.random.default_rng(0)
+
+    def warmup():
+        # The 20-token prompt walks every chunk rung — (8,0), (8,1),
+        # (8,2) — while the short prompt exercises budget contention
+        # and the single-chunk path; run() covers both block-table jit
+        # keys of the decode program.
+        eng.submit(rng.integers(0, cfg.vocab, 20), max_new=3)
+        eng.submit(rng.integers(0, cfg.vocab, 5), max_new=3)
+        eng.run()
+
+    def wave(plen: int):
+        def go():
+            eng.submit(rng.integers(0, cfg.vocab, plen), max_new=3)
+            eng.submit(rng.integers(0, cfg.vocab, 5), max_new=2)
+            eng.run()
+        return go
+
+    steady = [wave(20), wave(19), wave(18)]
+    return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
+
+
 def _paged_spec_batcher_scenario() -> tuple:
     """Speculative edition of the paged scenario: steady-state VERIFY
     dispatches across waves whose ACCEPT LENGTHS vary (self-repetitive
@@ -380,6 +425,7 @@ def recompile_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
          _paged_traced_batcher_scenario),
         ("batcher_steady_decode_paged_prefix", _paged_prefix_batcher_scenario),
         ("batcher_steady_decode_paged_spec", _paged_spec_batcher_scenario),
+        ("batcher_steady_mixed_chunked", _paged_chunked_batcher_scenario),
         ("generate_steady_state", _generate_scenario),
     ]
 

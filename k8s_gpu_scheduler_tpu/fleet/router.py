@@ -12,6 +12,7 @@ per-phase p50s) into the registry, and admission scores
                      × (eps + free_page_frac)
                      × (eps + free_slot_frac)
                      × 1 / (1 + decode_p50 / p50_ref)
+                     × 1 / (1 + prefill_backlog / backlog_ref)
 
 taking the argmax with a deterministic tiebreak (lowest replica id —
 same summaries, same placement, always). The match term routes shared
@@ -20,7 +21,12 @@ scales with the novel suffix — PR 4); the load terms keep a cold cache
 from losing every request to a hot one; the latency term is the
 DistServe observation that decode-phase pressure (TPOT) is the thing
 co-placement hurts, so it is scored per-phase rather than folded into a
-scalar load average. When summaries are STALE (an unreachable registry,
+scalar load average. The backlog term is the prefill-phase complement
+(chunked prefill, PR 9): admitted-but-unfinished prefill tokens are
+pressure the page/slot axes cannot see — a replica grinding through a
+long prompt's chunks holds few extra slots, so without the discount a
+long-prompt flood keeps landing on the same replica until its pool
+finally fills. When summaries are STALE (an unreachable registry,
 a wedged publisher — the bounded-retry clients of utils/retry.py fail
 fast rather than hang) routing degrades to deterministic round-robin:
 worse placement, zero additional risk.
@@ -56,7 +62,7 @@ from .summary import (
 
 # Phases feeding the routing p50s (the names _obs_span records).
 _DECODE_PHASES = ("decode_chunk", "verify")
-_PREFILL_PHASES = ("prefill",)
+_PREFILL_PHASES = ("prefill", "prefill_chunk")
 
 
 class FleetError(RuntimeError):
@@ -107,6 +113,7 @@ class Router:
                  clock=None, tracer=None, metrics=None,
                  digest_top_k: int = 8, digest_max_tokens: int = 512,
                  p50_ref_s: float = 0.05, load_eps: float = 0.1,
+                 backlog_ref_tokens: float = 2048.0,
                  auto_shed: bool = False,
                  shed_free_frac: float = 0.125,
                  shed_target_free_frac: float = 0.5) -> None:
@@ -156,6 +163,7 @@ class Router:
         self.digest_max_tokens = int(digest_max_tokens)
         self.p50_ref_s = float(p50_ref_s)
         self.load_eps = float(load_eps)
+        self.backlog_ref_tokens = float(backlog_ref_tokens)
         self.auto_shed = bool(auto_shed)
         self.shed_free_frac = float(shed_free_frac)
         self.shed_target_free_frac = float(shed_target_free_frac)
@@ -250,7 +258,9 @@ class Router:
         eps = self.load_eps
         load = ((eps + summary.free_frac)
                 * (eps + summary.free_slot_frac)
-                / (1.0 + summary.decode_p50_s / self.p50_ref_s))
+                / (1.0 + summary.decode_p50_s / self.p50_ref_s)
+                / (1.0 + max(0, summary.prefill_backlog_tokens)
+                   / self.backlog_ref_tokens))
         return (1.0 + match) * load, match
 
     def route(self, prompt: Sequence[int]) -> Tuple[str, str, int]:

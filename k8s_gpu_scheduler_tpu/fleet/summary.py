@@ -59,6 +59,13 @@ class ReplicaSummary:
     queued: int = 0
     decode_p50_s: float = 0.0
     prefill_p50_s: float = 0.0
+    # Admitted-but-unfinished prefill tokens (chunked prefill — the
+    # engine's _prefill_backlog). Slots/pages alone cannot see a
+    # long-prompt flood: a replica grinding through chunked prefills
+    # looks as "free" as an idle one on those axes, so without this
+    # field the router keeps landing new long prompts on it. Default 0
+    # keeps pre-chunking summaries parsing.
+    prefill_backlog_tokens: int = 0
     # [(token path, full cached token length)], hottest first.
     digest: List[Tuple[List[int], int]] = field(default_factory=list)
 
@@ -102,6 +109,7 @@ def summarize(engine, replica: str, fleet: str = "fleet", seq: int = 0,
         active_slots=int(st["active_slots"]), queued=int(st["queued"]),
         decode_p50_s=float(decode_p50_s),
         prefill_p50_s=float(prefill_p50_s),
+        prefill_backlog_tokens=int(st.get("prefill_backlog_tokens", 0)),
         digest=engine.cache_digest(top_k, max_tokens),
     )
 

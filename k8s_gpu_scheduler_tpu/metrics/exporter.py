@@ -239,6 +239,14 @@ SERVING_POOL_GAUGES = {
     "prefix_inserted_pages": "cumulative pages adopted into the tree",
     "prefix_evictions": "cumulative prefix-cache pages evicted (LRU)",
     "prefill_tokens_skipped": "prefill rows skipped via prefix reuse",
+    # Chunked prefill (serving.ContinuousBatcher prefill_chunk_tokens):
+    # backlog = admitted-but-unfinished prefill tokens (the fleet
+    # router's prefill-pressure input), chunks = cumulative budgeted
+    # chunk dispatches.
+    "prefill_backlog_tokens":
+        "prompt tokens admitted but not yet prefilled (chunked prefill)",
+    "prefill_chunks_total":
+        "cumulative chunked-prefill dispatches (per-slot chunks)",
     "spec_accept_rate": "speculative proposals accepted / proposed",
     "spec_tokens_per_dispatch":
         "tokens committed per active slot per verify dispatch",
@@ -304,7 +312,7 @@ def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
         hist = registry.histogram(
             PHASE_HISTOGRAM,
             "Request-lifecycle phase durations (queue|admit|prefill|"
-            "decode_chunk|verify|rewind|reap), by phase",
+            "prefill_chunk|decode_chunk|verify|rewind|reap), by phase",
             buckets=PHASE_BUCKETS)
         for phase, seconds in phases:
             hist.observe(float(seconds), phase=str(phase), **labels)

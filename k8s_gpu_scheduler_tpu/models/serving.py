@@ -42,7 +42,15 @@ draft-model-free — the reference implementation) and the paged batcher's
 mirror, one batched multi-query verify dispatch over all slots through
 ``ops.paged_verify_attention``, vectorized accept/reject, rewind by
 clamping each slot's ``lens`` — up to gamma+1 committed tokens per slot
-per dispatch).
+per dispatch) — and CHUNKED PREFILL (``prefill_chunk_tokens=N``,
+Sarathi-Serve-style): admission only reserves pages and binds the slot,
+and each step spends at most N prompt tokens advancing partially-
+prefilled slots (oldest first) before the decode/verify chunk, so a
+long-prompt arrival costs every active decode slot a bounded per-step
+overhead instead of one whole-prefill stall. A continuation chunk IS
+the prefix-cache tail-prefill program — the "hit" is the rows this
+slot's own earlier chunks made resident — so chunked == unchunked
+token identity rides the same argument as cache-on == cache-off.
 
 The reference has no serving engine at all (it schedules inference pods,
 SURVEY.md §0); this is the workload side of BASELINE config 5
@@ -1006,7 +1014,25 @@ class ContinuousBatcher:
     with the pool/scales/table donated every dispatch. Acceptance is
     content-dependent (the host must see each step's tokens to propose
     the next), so speculative steps flush per dispatch like eos mode —
-    the deferred-drain fast path doesn't apply."""
+    the deferred-drain fast path doesn't apply.
+
+    ``prefill_chunk_tokens=N`` (paged only) makes prefill INCREMENTAL:
+    admission reserves the worst-case pages and binds the slot as
+    before, but dispatches nothing — each step a token-budget scheduler
+    (``_advance_prefill``) spends at most N prompt tokens advancing
+    partially-prefilled slots oldest-first, then the normal decode/
+    verify chunk runs over the fully-prefilled slots. A continuation
+    chunk reuses the prefix-cache tail-prefill program verbatim (the
+    resident rows below ``prefill_done`` ride as the hb>0 prefix
+    tables, the chunk resumes at per-slot rope offsets via
+    ``hit_lens``), so the dispatch shapes stay the bounded (tb, hb)
+    rung ladder and steady-state mixed prefill+decode is zero-retrace
+    with the pool donated throughout. The FINAL chunk emits the
+    request's first token; mid-prefill slots are simply inactive in
+    decode/verify dispatches. This bounds the worst-case decode-step
+    latency by the chunk budget regardless of arriving prompt length —
+    the TTFT/decode-interference fix (Sarathi-Serve/DistServe), and
+    stage (a) of the ROADMAP disaggregation item."""
 
     def __init__(self, params, cfg: LlamaConfig, n_slots: int = 8,
                  max_len: Optional[int] = None, chunk: int = 8,
@@ -1017,6 +1043,7 @@ class ContinuousBatcher:
                  page_size: int = DEFAULT_PAGE_SIZE,
                  n_pages: Optional[int] = None,
                  prefix_cache: bool = False,
+                 prefill_chunk_tokens: Optional[int] = None,
                  speculative: bool = False, gamma: int = 4,
                  fault_injector=None, tracer=None, clock=None,
                  flight_capacity: int = 256):
@@ -1183,11 +1210,39 @@ class ContinuousBatcher:
             self._prefix = (PrefixCache(self._alloc, page_size)
                             if prefix_cache else None)
             self._skipped_tokens = 0                 # prefill rows reused
+            # Chunked prefill: the per-STEP prompt-token budget the
+            # advance phase spends on partially-prefilled slots. None =
+            # whole prompts dispatch at admission (pre-chunking
+            # behavior, byte-identical). Page-multiple so every
+            # non-final chunk ends page-aligned — the chunk scatter
+            # writes whole pages and the next chunk's resident prefix
+            # must be whole pages.
+            if prefill_chunk_tokens is not None:
+                prefill_chunk_tokens = int(prefill_chunk_tokens)
+                if (prefill_chunk_tokens < page_size
+                        or prefill_chunk_tokens % page_size):
+                    raise ValueError(
+                        f"prefill_chunk_tokens ({prefill_chunk_tokens}) "
+                        f"must be a positive multiple of page_size "
+                        f"({page_size})")
+            self._prefill_chunk = prefill_chunk_tokens
+            # slot -> prompt tokens already resident (page-aligned until
+            # the final chunk). Insertion order IS the FCFS budget
+            # order. Populated by chunked admission and by restore/
+            # absorb of a mid-prefill snapshot — so it exists (and the
+            # advance phase runs) even with chunking off.
+            self._prefill_pending: "OrderedDict[int, int]" = OrderedDict()
+            self._prefill_chunks_total = 0
         else:
             if prefix_cache:
                 raise ValueError(
                     "prefix_cache=True requires kv_layout='paged' (the "
                     "contiguous cursor cache has no shareable pages)")
+            if prefill_chunk_tokens is not None:
+                raise ValueError(
+                    "prefill_chunk_tokens requires kv_layout='paged' "
+                    "(chunks land page-granular through the block "
+                    "tables)")
             if kv_dtype == "int8":
                 shape = (cfg.n_layers, n_slots, self.S, cfg.n_kv_heads,
                          cfg.head_dim)
@@ -1761,6 +1816,19 @@ class ContinuousBatcher:
                                rid=req_id, slot=slot, bucket=tb,
                                hit_pages=len(hits), new_pages=len(pages),
                                evicted=evicted)
+            if self._prefill_chunk is not None:
+                # Chunked admission: bind the slot and queue its prefill
+                # for the budgeted advance phase (_advance_prefill) —
+                # nothing dispatches here. The request's first token
+                # comes from its FINAL chunk, so the budget decrement
+                # (and the max_new==1 fast finish) happen there, and
+                # the slot is occupied from now until then.
+                self._slot_req[slot] = req_id
+                self._slot_pages[slot] = pages
+                self._slot_shared[slot] = hits
+                self._slot_prompt[slot] = prompt
+                self._prefill_pending[slot] = hit_tok
+                continue
             self._budget[req_id] -= 1                # first token = prefill
             if self._budget[req_id] <= 0:            # max_new == 1
                 finished.append(req_id)
@@ -1776,6 +1844,11 @@ class ContinuousBatcher:
                 self._slot_shared[slot] = hits
                 self._slot_prompt[slot] = prompt
 
+        if self._prefill_chunk is not None:
+            # Chunked mode: every admission above went to the pending
+            # queue; _advance_prefill owns the dispatching.
+            self._step_admitted = len(adm)
+            return finished
         # Same one-padded-dispatch-per-rung grouping as the contiguous
         # path (_group_admissions: slot-repeat contiguity split, pad with
         # the LAST entry — duplicate page ids then carry identical
@@ -1784,36 +1857,25 @@ class ContinuousBatcher:
             tb, hb = run[0][4]
             npg = -(-tb // self.page_size)
             rows = run + [run[-1]] * (self.n_slots - len(run))
-            # Tail tokens only: the cached prefix (hit pages) is already
-            # resident; its length per entry rides as hlens.
-            tails = [p[len(h) * self.page_size:]
-                     for _, _, _, p, _, h in rows]
-            tokens = np.asarray(
-                [t + [0] * (tb - len(t)) for t in tails], np.int32)
-            # Page-id matrix for the prefill scatter: the entry's OWN
-            # reserved pages in logical order; the beyond-need tail of an
-            # overshooting bucket targets the null page. Shared hit pages
-            # are deliberately absent — the scatter must never touch them.
-            pids = np.asarray(
-                [[pg[j] if j < len(pg) else NULL_PAGE for j in range(npg)]
-                 for _, _, pg, _, _, _ in rows], np.int32)
-            ptbl = np.asarray(
-                [[h[j] if j < len(h) else NULL_PAGE for j in range(hb)]
-                 for _, _, _, _, _, h in rows], np.int32).reshape(
-                self.n_slots, hb)                    # keep [M, 0] 2-D
-            hlens = np.asarray(
-                [len(h) * self.page_size for _, _, _, _, _, h in rows],
-                np.int32)
-            self._dispatch_no += 1
+            # Normalized dispatch rows: tail tokens only (the cached
+            # prefix is already resident, its length rides as hit_len);
+            # the page-id row holds the entry's OWN reserved pages in
+            # logical order with the overshooting bucket tail on the
+            # null page — shared hit pages are deliberately absent from
+            # it (the scatter must never touch them) and ride the
+            # prefix row instead.
+            norm = []
+            for _, slot, pg, p, _, h in rows:
+                tail = p[len(h) * self.page_size:]
+                norm.append((
+                    slot,
+                    [pg[j] if j < len(pg) else NULL_PAGE
+                     for j in range(npg)],
+                    [h[j] if j < len(h) else NULL_PAGE
+                     for j in range(hb)],
+                    len(h) * self.page_size, tail, len(tail)))
             t_pf = self._clock.monotonic()
-            (self._k, self._v, self._ks, self._vs, self._lens, self._last,
-             firsts_arr) = self._prefill(
-                self.params, self._k, self._v, self._ks, self._vs,
-                self._lens, self._last,
-                np.asarray([s for _, s, *_ in rows], np.int32),
-                pids, ptbl, hlens, tokens,
-                np.asarray([len(t) for t in tails], np.int32),
-                np.int32(self._dispatch_no))
+            firsts_arr = self._dispatch_prefill_paged(norm, tb, hb)
             self._reads.append(
                 ("firsts", firsts_arr, [rid for rid, *_ in run]))
             if self._tracer is not None:
@@ -1840,10 +1902,219 @@ class ContinuousBatcher:
         self._table_dirty = False
         return table
 
+    # -- chunked prefill ---------------------------------------------------
+    def _chunk_ladder(self, n: int) -> int:
+        """Prefill-chunk token bucket: the page size doubled until the
+        chunk fits — page-multiple by construction (the chunk scatter
+        writes whole pages) and a bounded rung set no matter the prompt
+        length: the admission ladder's idea anchored at the page
+        instead of the prefill bucket."""
+        tb = self.page_size
+        while tb < n:
+            tb *= 2
+        return min(tb, self.S)
+
+    def _prefill_backlog(self) -> int:
+        """Admitted-but-unfinished prefill tokens — the fleet router's
+        prefill-pressure signal (queued prompts are NOT counted: they
+        hold no pages yet and any replica could still take them)."""
+        return sum(len(self._slot_prompt[s]) - d
+                   for s, d in self._prefill_pending.items())
+
+    def _dispatch_prefill_paged(self, rows, tb: int, hb: int):
+        """ONE padded paged-prefill dispatch — the single marshalling
+        point both admission (whole prompts / prefix tails) and the
+        chunk scheduler (continuation chunks) feed, so the jitted
+        program's calling convention and the padding contract live in
+        exactly one place and the two paths cannot drift. ``rows`` are
+        (slot, page-id row [tb/ps], prefix row [hb], hit_len, tokens,
+        tail_len) tuples already padded to n_slots by REPEATING the
+        last real entry (duplicate page ids then carry identical
+        values, keeping the scatter idempotent). Returns the
+        [n_slots] firsts array — the caller decides which rows are
+        real first tokens."""
+        tokens = np.asarray(
+            [t + [0] * (tb - len(t)) for _, _, _, _, t, _ in rows],
+            np.int32)
+        self._dispatch_no += 1
+        (self._k, self._v, self._ks, self._vs, self._lens, self._last,
+         firsts) = self._prefill(
+            self.params, self._k, self._v, self._ks, self._vs,
+            self._lens, self._last,
+            np.asarray([r[0] for r in rows], np.int32),
+            np.asarray([r[1] for r in rows], np.int32),
+            np.asarray([r[2] for r in rows],
+                       np.int32).reshape(self.n_slots, hb),  # [M, 0] 2-D
+            np.asarray([r[3] for r in rows], np.int32),
+            tokens,
+            np.asarray([r[5] for r in rows], np.int32),
+            np.int32(self._dispatch_no))
+        return firsts
+
+    def _advance_prefill(self) -> list:
+        """Spend the per-step prefill token budget advancing partially-
+        prefilled slots: PAGE-QUANTUM ROUND-ROBIN, oldest admission
+        first. Each allocation pass hands every pending slot one page's
+        worth of its prompt (or its final partial remainder) until the
+        budget is spent — the oldest slot always draws the first
+        quantum (no starvation), and a short prompt slips into the same
+        step's budget as a long one mid-walk instead of queueing behind
+        its whole remaining prefill (head-of-line blocking would hand
+        back the TTFT damage chunking exists to remove; with
+        budget == page_size the policy degenerates to strict
+        oldest-first, one quantum per step). Allocation is a pure
+        function of the pending set — no wall-clock input — so a
+        replayed trace chunks identically.
+
+        One bounded-shape dispatch per (tb, hb) rung through the SAME
+        jitted prefill program family admission uses: a continuation
+        chunk is exactly a prefix-cache tail prefill whose "hit" is the
+        rows this slot's own earlier chunks made resident (prefix
+        tables = the block-table row below ``prefill_done``, per-slot
+        rope offsets via ``hit_lens``), so chunked == unchunked token
+        identity rides the same argument as cache-on == cache-off, and
+        int8-KV chunking inherits exactly its quantization-noise bound
+        (chunk queries attend the DEQUANTIZED resident rows — what
+        decode also attends). Every non-final chunk ends page-aligned;
+        the bucket tail a chunk overshoots into the NEXT chunk's pages
+        is overwritten whole-page by that chunk before anything can
+        attend it (rows above ``lens`` are masked throughout).
+
+        The FINAL chunk emits the request's first token from its
+        last-position logits — the budget decrement and the max_new==1
+        fast finish happen here, not at admission; intermediate chunks
+        discard the sampled row (their readback meta rid is None).
+        Returns the requests that finished (max_new == 1 final chunks).
+
+        Runs whenever ``_prefill_pending`` is non-empty — with chunking
+        OFF (budget None, e.g. a mid-prefill slot restored/absorbed
+        from a chunked peer) each pending slot's whole remainder
+        dispatches as one chunk."""
+        if not self._prefill_pending:
+            return []
+        budget = self._prefill_chunk
+        remaining = {s: len(self._slot_prompt[s]) - d
+                     for s, d in self._prefill_pending.items()}
+        grants: Dict[int, int] = {}
+        if budget is None:
+            grants = dict(remaining)
+        else:
+            left = budget
+            progressed = True
+            while progressed and left > 0:
+                progressed = False
+                for slot in self._prefill_pending:
+                    rem = remaining[slot] - grants.get(slot, 0)
+                    if rem <= 0:
+                        continue
+                    # A whole page, or the slot's final partial tail —
+                    # never a partial NON-final quantum, which would
+                    # leave the next chunk starting mid-page. A quantum
+                    # the leftover cannot fund is skipped (a smaller
+                    # final tail further down may still fit); the
+                    # skipped slot draws FIRST from the next step's
+                    # budget, so nothing starves.
+                    q = min(self.page_size, rem)
+                    if q > left:
+                        continue
+                    grants[slot] = grants.get(slot, 0) + q
+                    left -= q
+                    progressed = True
+                    if left <= 0:
+                        break
+        # (rid, slot, chunk page ids, chunk tokens, (tb, hb), done, cb,
+        # final) — slot at [1] and the rung at [4], the positions
+        # _group_admissions reads.
+        entries: list = []
+        for slot, done in list(self._prefill_pending.items()):
+            cb = grants.get(slot, 0)
+            if cb <= 0:
+                continue
+            prompt = self._slot_prompt[slot]
+            tb = self._chunk_ladder(cb)
+            npg = tb // self.page_size
+            start_pg = done // self.page_size
+            row = self._table_np[slot]
+            # The chunk's OWN pages in logical order; the bucket's
+            # beyond-reservation tail targets the null page (rows there
+            # are never attended — lens stops below them).
+            pids = [int(row[start_pg + j]) if start_pg + j < self.n_blocks
+                    else NULL_PAGE for j in range(npg)]
+            entries.append((self._slot_req[slot], slot, pids,
+                            [int(t) for t in prompt[done:done + cb]],
+                            (tb, self._hb_bucket(start_pg)), done, cb,
+                            done + cb >= len(prompt)))
+        finished: list = []
+        retire: list = []
+        for run in self._group_admissions(entries):
+            tb, hb = run[0][4]
+            rows = run + [run[-1]] * (self.n_slots - len(run))
+            # Resident prefix per entry: the table row below its
+            # prefill_done — shared hit pages first, then the pages its
+            # earlier chunks wrote — null-padded to the hb rung.
+            norm = [(e[1], e[2],
+                     [int(self._table_np[e[1]][j])
+                      if j < e[5] // self.page_size else NULL_PAGE
+                      for j in range(hb)],
+                     e[5], e[3], e[6]) for e in rows]
+            t_pf = self._clock.monotonic()
+            firsts_arr = self._dispatch_prefill_paged(norm, tb, hb)
+            # Only FINAL chunks carry a real first token; intermediate
+            # rows ride as rid None and _flush drops them.
+            self._reads.append(
+                ("firsts", firsts_arr,
+                 [e[0] if e[7] else None for e in run]))
+            self._prefill_chunks_total += len(run)
+            if self._tracer is not None:
+                t1 = self._clock.monotonic()
+                self._obs_span("prefill_chunk", t_pf, t1, bucket=tb,
+                               prefix_bucket=hb,
+                               tokens=int(sum(e[6] for e in run)),
+                               requests=[self._rid(e[0]) for e in run])
+                for e in run:
+                    self._obs_span("prefill_chunk", t_pf, t1, rid=e[0],
+                                   lane=f"slot{e[1]}", fold=False,
+                                   tokens=e[6], done=e[5] + e[6],
+                                   final=e[7])
+        for rid, slot, _, _, _, done, cb, fin in entries:
+            if not fin:
+                self._prefill_pending[slot] = done + cb
+                continue
+            del self._prefill_pending[slot]
+            self._budget[rid] -= 1           # first token = final chunk
+            if self._budget[rid] <= 0:               # max_new == 1
+                finished.append(rid)
+                del self._budget[rid]
+                del self._slot_req[slot]
+                # The dispatch above still writes these pages; retire
+                # (donate + release) only after every run is enqueued.
+                retire.append(slot)
+                if self._tracer is not None:
+                    t_rp = self._clock.monotonic()
+                    self._obs_span("reap", t_rp, self._clock.monotonic(),
+                                   rid=rid, slot=slot)
+        for slot in retire:
+            self._free_slot_pages(slot)
+        if entries and self._flight is not None:
+            self._flight.record(
+                "prefill_chunk", slots=len(entries),
+                tokens=int(sum(e[6] for e in entries)),
+                backlog=self._prefill_backlog(),
+                retired=len(finished))
+        return finished
+
     def _step_lazy_paged(self) -> list:
-        """Admit (see _admit_paged), then dispatch one decode chunk."""
+        """Admit (see _admit_paged), advance any pending prefill chunks
+        (_advance_prefill — the chunked-prefill budget phase), then
+        dispatch one decode chunk over the fully-prefilled slots.
+        Mid-prefill slots ride the decode dispatch inactive; a step
+        with nothing fully prefilled is a pure-prefill step and skips
+        the decode dispatch entirely."""
         finished = self._admit_paged()
-        if not self._slot_req:
+        finished.extend(self._advance_prefill())
+        ready = {s: r for s, r in self._slot_req.items()
+                 if s not in self._prefill_pending}
+        if not ready:
             if self._flight is not None:
                 self._flight.record("admit_only", active=0,
                                     admitted=self._step_admitted,
@@ -1852,7 +2123,7 @@ class ContinuousBatcher:
                                     faults=self._step_faults)
             return finished
         active = np.asarray(
-            [s in self._slot_req for s in range(self.n_slots)])
+            [s in ready for s in range(self.n_slots)])
         table = self._device_table()
         self._dispatch_no += 1
         t_dec = self._clock.monotonic()
@@ -1862,7 +2133,7 @@ class ContinuousBatcher:
             self._lens, self._last, active, np.int32(self._dispatch_no))
 
         takes: list = []                             # (req id, slot, n tokens)
-        for slot, req_id in list(self._slot_req.items()):
+        for slot, req_id in list(ready.items()):
             budget = self._budget[req_id]
             take = min(budget, self.chunk)
             takes.append((req_id, slot, take))
@@ -1948,15 +2219,20 @@ class ContinuousBatcher:
         step flushes and reads the verify back synchronously instead of
         deferring to the drain — the same trade eos mode makes."""
         finished = self._admit_paged()
-        if not self._slot_req:
+        finished.extend(self._advance_prefill())
+        ready = {s: r for s, r in self._slot_req.items()
+                 if s not in self._prefill_pending}
+        if not ready:
             return finished
         # Proposals read the committed stream, so the prefill firsts of
         # requests admitted THIS step must be host-visible first (this
         # also keeps per-request token order intact: firsts land in
-        # _out before the verify's direct appends below).
+        # _out before the verify's direct appends below). Mid-prefill
+        # slots have no committed stream yet — they sit out the verify
+        # (inactive window rows, no proposal, no commit).
         self._flush()
         props = np.zeros((self.n_slots, self.gamma), np.int32)
-        for slot, rid in list(self._slot_req.items()):
+        for slot, rid in list(ready.items()):
             # Per-request error isolation: a poison request (host-side
             # failure building ITS proposal — chaos hook serve.propose,
             # or a genuine assert in the mirror/bigram code) fails THAT
@@ -1971,10 +2247,12 @@ class ContinuousBatcher:
                 raise
             except Exception as e:  # noqa: BLE001 — isolate the poison request
                 self._fail_request(slot, rid, e)
-        if not self._slot_req:                       # every slot poisoned
+        ready = {s: r for s, r in self._slot_req.items()
+                 if s not in self._prefill_pending}
+        if not ready:                                # every slot poisoned
             return finished
         active = np.asarray(
-            [s in self._slot_req for s in range(self.n_slots)])
+            [s in ready for s in range(self.n_slots)])
         table = self._device_table()
         self._dispatch_no += 1
         t_ver = self._clock.monotonic()
@@ -1987,7 +2265,7 @@ class ContinuousBatcher:
         t_ver1 = self._clock.monotonic()
         step_used = step_emitted = 0
 
-        for slot, req_id in list(self._slot_req.items()):
+        for slot, req_id in list(ready.items()):
             acc = int(accepts[slot])
             take = min(self._budget[req_id], acc + 1)
             self._out[req_id].extend(int(tk) for tk in toks[slot, :take])
@@ -2083,6 +2361,8 @@ class ContinuousBatcher:
         self._eos_scanned.pop(rid, None)
         if self.spec:
             self._spec_mirror.pop(slot, None)
+        if self.layout == "paged":
+            self._prefill_pending.pop(slot, None)
         if self.layout == "paged" and slot in self._slot_pages:
             self._free_slot_pages(slot)
         self._out.pop(rid, None)
@@ -2098,9 +2378,14 @@ class ContinuousBatcher:
         reservations for (chunk, spec, gamma). ``n_pages`` is recorded
         but EXEMPT from the restore check — pages are re-laid-out through
         the fresh allocator, so pool size may differ (snapshot.py
-        check_fingerprint). Model WEIGHTS are the caller's obligation:
-        restore into an engine holding different params resumes streams
-        that decode differently, and no fingerprint can see that."""
+        check_fingerprint). ``prefill_chunk_tokens`` is deliberately NOT
+        part of the contract: chunking is a pure scheduling knob — a
+        chunked engine's mid-prefill snapshot restores into an unchunked
+        one (the tail prefills in one dispatch) and vice versa, with no
+        effect on page layout or token identity. Model WEIGHTS are the
+        caller's obligation: restore into an engine holding different
+        params resumes streams that decode differently, and no
+        fingerprint can see that."""
         cfg = self.cfg
         fp: Dict[str, object] = {
             "layout": self.layout,
@@ -2237,6 +2522,17 @@ class ContinuousBatcher:
         def keep_rid(r):
             return not partial or int(r) in shed_rids
 
+        # A mid-prefill slot's device lens is not authoritative (chunked
+        # admission dispatches nothing, so its row may still hold the
+        # previous occupant's value); the host chunk scheduler is. The
+        # snapshot carries lens = prefill_done, which is ALSO how
+        # restore/absorb recognize the slot as mid-prefill
+        # (lens < len(prompt)) and re-queue its unprefilled tail.
+        lens = np.array(lens, np.int32)
+        for s, d in self._prefill_pending.items():
+            if keep_slot(s):
+                lens[s] = d
+
         snap = ServingSnapshot(
             fingerprint=self.fingerprint(),
             page_ids=ids,
@@ -2299,6 +2595,7 @@ class ContinuousBatcher:
                 self._first_tok.pop(rid, None)
                 if self.spec:
                     self._spec_mirror.pop(slot, None)
+                self._prefill_pending.pop(slot, None)
                 self._free_slot_pages(slot)
             if self._flight is not None:
                 self._flight.record(
@@ -2388,6 +2685,17 @@ class ContinuousBatcher:
         self._next_id = snap.next_id
         self._eos_scanned = dict(snap.eos_scanned)
         self._skipped_tokens = snap.skipped_tokens
+        # Slots drained MID-PREFILL (lens < prompt length — chunked
+        # prefill, or an absorbed peer's chunk state) re-queue their
+        # unprefilled tail; the advance phase resumes them — budgeted
+        # when this engine chunks, in one dispatch when it doesn't.
+        # FCFS order rebuilt by request id (lower id = earlier
+        # admission).
+        lens_np = np.asarray(snap.lens)
+        for s in sorted(self._slot_req, key=lambda s: self._slot_req[s]):
+            pr = self._slot_prompt.get(s)
+            if pr is not None and int(lens_np[s]) < len(pr):
+                self._prefill_pending[s] = int(lens_np[s])
         now_m, now_w = self._clock.monotonic(), self._clock.wall()
         self._arrival = snap.rebased_clock(snap.arrival, now_m, now_w)
         self._first_tok = snap.rebased_clock(snap.first_tok, now_m, now_w)
@@ -2493,7 +2801,13 @@ class ContinuousBatcher:
         lens, last = np.array(got[0]), np.array(got[1])  # writable copies
         mapping: Dict[int, int] = {}
         claimed: set = set()
-        for src_slot in sorted(snap.slot_req):
+        # Source-rid order, not slot order: admission hands out HIGH
+        # slots first (free.pop()), so slot order would typically invert
+        # admission order — and _prefill_pending insertion order is the
+        # chunk scheduler's FCFS, which must keep charging the OLDEST
+        # migrated request first (restore() sorts by rid for the same
+        # reason).
+        for src_slot in sorted(snap.slot_req, key=lambda s: snap.slot_req[s]):
             rid = int(snap.slot_req[src_slot])
             tgt = free_slots.pop(0)
             new_rid = self._next_id
@@ -2527,6 +2841,10 @@ class ContinuousBatcher:
                 self._first_tok[new_rid] = first[rid]
             lens[tgt] = int(snap.lens[src_slot])
             last[tgt] = int(snap.last[src_slot])
+            if lens[tgt] < len(self._slot_prompt[tgt]):
+                # Shed mid-prefill: re-queue the unprefilled tail here
+                # (the advance phase finishes it — budgeted or whole).
+                self._prefill_pending[tgt] = int(lens[tgt])
         self._lens = jnp.asarray(lens, jnp.int32)
         self._last = jnp.asarray(last, jnp.int32)
         self._table_dirty = True
@@ -2557,6 +2875,11 @@ class ContinuousBatcher:
             "n_slots": self.n_slots,
             "active_slots": len(self._slot_req),
             "queued": len(self._queue),
+            # Prefill pressure (chunked prefill): tokens admitted but
+            # not yet prefilled — the blind spot that let long-prompt
+            # floods keep landing on one replica (the router folds a
+            # discount on it into its score).
+            "prefill_backlog_tokens": self._prefill_backlog(),
         }
 
     def cache_digest(self, top_k: int = 8,
@@ -2615,6 +2938,13 @@ class ContinuousBatcher:
         if self._prefix is not None:
             out.update(self._prefix.metrics())
             out["prefill_tokens_skipped"] = float(self._skipped_tokens)
+        # Chunked-prefill gauges: backlog is the instantaneous prefill
+        # pressure (admitted-but-unfinished prompt tokens, the fleet
+        # routing input), chunks_total the cumulative chunk dispatches.
+        # Present for every paged engine — 0/0 with chunking off unless
+        # a restore/absorb re-queued a peer's mid-prefill slot.
+        out["prefill_backlog_tokens"] = float(self._prefill_backlog())
+        out["prefill_chunks_total"] = float(self._prefill_chunks_total)
         # ONE lock snapshot for everything the step loop mutates: the
         # watchdog age, the spec gauges and the drained phase batch all
         # come from the same instant, so a scrape racing a step can
@@ -2661,6 +2991,11 @@ class ContinuousBatcher:
         for (kind, _, meta), vals in zip(self._reads, arrays):
             if kind == "firsts":
                 for req_id, val in zip(meta, vals):  # pad rows fall off
+                    if req_id is None:
+                        # Intermediate prefill chunk: the sampled row is
+                        # scratch — only the FINAL chunk's logits are a
+                        # request's first token.
+                        continue
                     if not self._out[req_id]:
                         self._first_tok.setdefault(req_id, now)
                     self._out[req_id].append(int(val))

@@ -29,8 +29,8 @@ from .trace import Span
 # The request-lifecycle phase taxonomy (README "Observability" documents
 # each): every engine span name is one of these; the scheduler plane adds
 # its own sched_* names on control-plane lanes.
-PHASES = ("queue", "admit", "prefill", "decode_chunk", "verify", "rewind",
-          "reap", "drain", "restore")
+PHASES = ("queue", "admit", "prefill", "prefill_chunk", "decode_chunk",
+          "verify", "rewind", "reap", "drain", "restore")
 
 _ENGINE_PID = 1
 _CONTROL_PID = 2

@@ -525,6 +525,57 @@ class TestBatcherSteadyState:
         assert recompile_guard.misses_since() == {"decode": 0, "prefill": 0}
         eng.run()                                  # drain the long request
 
+    def test_chunked_mixed_waves_zero_retrace(self, recompile_guard):
+        """Chunked-prefill edition: waves that INTERLEAVE a long
+        prompt's budgeted prefill chunks with live decode traffic must
+        be zero-retrace — every chunk is a (tb, hb) rung of the same
+        prefill program family, hb walking up as the slot's own earlier
+        chunks become the resident prefix — and the pool must ride the
+        donation chain through prefill-chunk and decode dispatches
+        alike. Mirrors the registered graftcheck scenario
+        ``batcher_steady_mixed_chunked``."""
+        import jax
+
+        from k8s_gpu_scheduler_tpu.models.llama import (
+            LlamaConfig, init_params,
+        )
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = LlamaConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=48,
+                                chunk=2, prefill_bucket=8, kv_dtype="int8",
+                                kv_layout="paged", page_size=8,
+                                prefill_chunk_tokens=8)
+        rng = np.random.default_rng(0)
+        # Warmup walks every chunk rung the waves use — (8,0) (8,1)
+        # (8,2) via the 20-token prompt — plus the single-chunk short
+        # rung and both block-table jit keys of the decode program.
+        eng.submit(rng.integers(0, cfg.vocab, 20), max_new=3)
+        eng.submit(rng.integers(0, cfg.vocab, 5), max_new=3)
+        eng.run()
+
+        recompile_guard.track("decode", eng._decode)
+        recompile_guard.track("prefill", eng._prefill)
+        recompile_guard.snapshot()
+        for plen in (20, 19, 18):
+            eng.submit(rng.integers(0, cfg.vocab, plen), max_new=3)
+            eng.submit(rng.integers(0, cfg.vocab, 5), max_new=2)
+            k_before = eng._k
+            eng.step()
+            # Donation held through the chunk dispatch (the pool is
+            # consumed by whichever program ran this step).
+            assert k_before.is_deleted(), "kv page pool was not donated"
+            eng.run()
+        assert recompile_guard.misses_since() == {"decode": 0,
+                                                  "prefill": 0}
+
+    def test_chunked_scenario_registered(self):
+        from k8s_gpu_scheduler_tpu.analysis import entrypoints as eps
+
+        names = [n for n, _ in eps.recompile_scenarios()]
+        assert "batcher_steady_mixed_chunked" in names
+
     def test_spec_three_waves_varying_accepts_zero_retrace(
             self, recompile_guard):
         """Speculative edition: three waves whose verify dispatches

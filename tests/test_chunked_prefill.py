@@ -1,0 +1,325 @@
+"""Chunked prefill: the token-budget step scheduler inside the paged
+``ContinuousBatcher`` (``prefill_chunk_tokens``).
+
+Proof obligations of the chunked-prefill PR:
+
+- **Token identity** — chunked streams are byte-equal to unchunked
+  streams for the same workload, across dense/fused × bf16(f32)/int8-KV
+  × prefix-cache × speculative. A continuation chunk is the prefix-cache
+  tail-prefill program with the slot's OWN earlier chunks as the
+  resident "hit", so the identity argument (and the int8 quantization-
+  noise bound) is cache-on == cache-off verbatim.
+- **Chunk-boundary edge cases** — drain/snapshot MID-PREFILL restores
+  (and shed/absorbs) token-identically, into chunked AND unchunked
+  targets; EOS arriving in the very first emitted chunk retires the
+  whole reservation; a prefix-cache hit landing exactly on a chunk
+  boundary resumes at the right rope offset; a step with zero fully-
+  prefilled slots is a pure-prefill step (no decode dispatch, no decode
+  flight record).
+- **Pressure observability** — ``prefill_backlog_tokens`` rises while a
+  long prompt chunks and drains to zero; ``prefill_chunks_total``
+  counts dispatches; both ride ``replica_stats()`` / ``pool_metrics()``.
+- **Bounded shapes** — zero-retrace steady state is test-pinned in
+  tests/test_analysis.py (``batcher_steady_mixed_chunked``) and in the
+  ``bench.py --leg chunked_prefill`` CI step.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def mk(params, cfg, chunked=None, **kw):
+    base = dict(n_slots=3, max_len=128, chunk=4, prefill_bucket=8,
+                kv_layout="paged", page_size=PAGE,
+                prefill_chunk_tokens=chunked)
+    base.update(kw)
+    return ContinuousBatcher(params, cfg, **base)
+
+
+def drive(eng, prompts, max_new=6):
+    ids = [eng.submit(p, max_new=max_new) for p in prompts]
+    done = {}
+    while eng.pending:
+        done.update(eng.step())
+    return [done[i] for i in ids]
+
+
+def workload(cfg, seed=0):
+    """Long + short + repetitive + shared-prefix prompts: every chunk
+    rung, budget contention, and (for spec/prefix cells) accepts and
+    cache hits."""
+    rng = np.random.default_rng(seed)
+    phrase = list(rng.integers(0, cfg.vocab, 3))
+    sysp = list(rng.integers(0, cfg.vocab, 2 * PAGE))
+    return [
+        list(rng.integers(0, cfg.vocab, 40)),        # 5 chunks at budget 8
+        list(rng.integers(0, cfg.vocab, 5)),         # single-chunk short
+        phrase * 9,                                  # spec accepts
+        sysp + list(rng.integers(0, cfg.vocab, 5)),  # prefix-cache class
+        sysp + phrase * 4,                           # hit + repetition
+        list(rng.integers(0, cfg.vocab, 22)),
+    ]
+
+
+class TestValidation:
+    def test_knob_requires_paged_and_page_multiple(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                              prefill_chunk_tokens=8)
+        with pytest.raises(ValueError, match="multiple"):
+            mk(params, cfg, chunked=PAGE - 1)
+        with pytest.raises(ValueError, match="multiple"):
+            mk(params, cfg, chunked=PAGE + 1)
+        with pytest.raises(ValueError, match="multiple"):
+            mk(params, cfg, chunked=0)
+
+
+class TestTokenIdentity:
+    """Chunked == unchunked streams, the tentpole contract. The dense-f32
+    plain cell, the fused-int8 prefix cell and the spec cell stay tier-1
+    (the production shapes); redundant combinations ride slow."""
+
+    CELLS = [
+        ("dense", None, False, False),
+        ("fused", "int8", True, False),
+        ("fused", "int8", False, True),
+        pytest.param("dense", None, True, True, marks=pytest.mark.slow),
+        pytest.param("fused", None, False, False, marks=pytest.mark.slow),
+        pytest.param("dense", "int8", True, False, marks=pytest.mark.slow),
+    ]
+
+    @pytest.mark.parametrize("impl,kvd,prefix,spec", CELLS)
+    def test_chunked_matches_unchunked(self, setup, impl, kvd, prefix, spec):
+        cfg, params = setup
+        cfg = dataclasses.replace(cfg, decode_attn=impl)
+        prompts = workload(cfg)
+        kw = dict(kv_dtype=kvd, prefix_cache=prefix, speculative=spec,
+                  gamma=2)
+        ref = drive(mk(params, cfg, chunked=None, **kw), prompts)
+        got = drive(mk(params, cfg, chunked=PAGE, **kw), prompts)
+        assert got == ref
+
+    def test_budget_larger_than_any_prompt_still_identical(self, setup):
+        """A budget that covers whole prompts degenerates to one chunk
+        per admission — still byte-identical, still one dispatch."""
+        cfg, params = setup
+        prompts = workload(cfg)
+        ref = drive(mk(params, cfg, chunked=None), prompts)
+        got = drive(mk(params, cfg, chunked=64), prompts)
+        assert got == ref
+
+
+class TestChunkBoundaries:
+    def test_eos_in_first_chunk(self, setup):
+        """The request's FIRST token (emitted by its final prefill
+        chunk) is eos: the whole worst-case reservation retires
+        immediately — pages back, slot reusable, stream truncated at
+        the eos."""
+        cfg, params = setup
+        prompts = workload(cfg)
+        # Learn the first emitted token of the long prompt, then make
+        # it the eos id.
+        first = drive(mk(params, cfg, chunked=PAGE), [prompts[0]])[0][0]
+        eng = mk(params, cfg, chunked=PAGE, eos_id=first)
+        rid = eng.submit(prompts[0], max_new=32)
+        done = {}
+        while eng.pending:
+            done.update(eng.step())
+        assert done[rid] == [first]
+        assert eng._alloc.in_use == 0
+        eng._alloc.assert_consistent()
+        # The slot admits the next request normally afterwards.
+        rid2 = eng.submit(prompts[1], max_new=3)
+        while eng.pending:
+            done.update(eng.step())
+        assert len(done[rid2]) >= 1
+
+    def test_prefix_hit_on_chunk_boundary(self, setup):
+        """A cached-prefix hit whose length is an exact multiple of the
+        chunk budget: the first chunk resumes at rope offset hit_len
+        (= k chunks' worth of rows it never prefilled), byte-identical
+        to the unchunked tail prefill."""
+        cfg, params = setup
+        rng = np.random.default_rng(3)
+        sysp = list(rng.integers(0, cfg.vocab, 2 * PAGE))  # hit == 2 chunks
+        warm = sysp + list(rng.integers(0, cfg.vocab, 4))
+        probe = sysp + list(rng.integers(0, cfg.vocab, 9))
+
+        def run(chunked):
+            eng = mk(params, cfg, chunked=chunked, prefix_cache=True)
+            drive(eng, [warm], max_new=2)     # reap donates the prefix
+            out = drive(eng, [probe], max_new=6)
+            return out, eng
+
+        ref, _ = run(None)
+        got, eng = run(PAGE)
+        assert got == ref
+        # The hit really was mounted: the probe skipped 2 pages of
+        # prefill, and its first chunk started AT the boundary.
+        assert eng.pool_metrics()["prefill_tokens_skipped"] >= 2 * PAGE
+
+    def test_pure_prefill_step(self, setup):
+        """An idle engine receiving one long prompt: the first steps
+        have ZERO fully-prefilled slots — no decode dispatch runs (the
+        flight ring shows admit_only/prefill_chunk records, no decode
+        record), backlog drains chunk by chunk, and decode begins only
+        after the final chunk."""
+        cfg, params = setup
+        eng = mk(params, cfg, chunked=PAGE)
+        rid = eng.submit(list(np.random.default_rng(4).integers(
+            0, cfg.vocab, 40)), max_new=5)
+        backlogs = []
+        for _ in range(4):                   # 40 tokens / 8 = 5 chunks
+            assert eng.step() == {}
+            backlogs.append(eng.pool_metrics()["prefill_backlog_tokens"])
+        kinds = {r["kind"] for r in eng._flight.records()}
+        assert "decode" not in kinds
+        assert backlogs == sorted(backlogs, reverse=True)
+        assert backlogs[-1] > 0
+        done = {}
+        while eng.pending:
+            done.update(eng.step())
+        assert len(done[rid]) == 5
+        assert eng.pool_metrics()["prefill_backlog_tokens"] == 0
+        assert "decode" in {r["kind"] for r in eng._flight.records()}
+
+    def test_budget_eq_page_is_oldest_first(self, setup):
+        """At budget == page_size the quantum allocator degenerates to
+        ONE quantum per step, drawn by the oldest pending slot — the
+        no-starvation floor (larger budgets round-robin further quanta
+        to younger slots, and may fund a small final tail the leftover
+        covers even when an older slot's full quantum doesn't fit)."""
+        cfg, params = setup
+        eng = mk(params, cfg, chunked=PAGE)
+        rng = np.random.default_rng(5)
+        r_long = eng.submit(rng.integers(0, cfg.vocab, 40), max_new=3)
+        r_short = eng.submit(rng.integers(0, cfg.vocab, 5), max_new=3)
+        eng.step()
+        pend = dict(eng._prefill_pending)
+        # Budget 8 went entirely to the long head; the short waits at 0.
+        assert max(pend.values()) == PAGE and min(pend.values()) == 0
+        done = {}
+        while eng.pending:
+            done.update(eng.step())
+        assert len(done[r_long]) == 3 and len(done[r_short]) == 3
+
+
+class TestLifecycle:
+    def test_drain_restore_mid_prefill(self, setup):
+        """A partially-prefilled slot survives drain -> pytree codec ->
+        restore and resumes token-identically — into a chunked target
+        AND an unchunked one (the tail then prefills in one dispatch)."""
+        from k8s_gpu_scheduler_tpu.models.snapshot import ServingSnapshot
+
+        cfg, params = setup
+        prompts = workload(cfg)[:3]
+        ref = drive(mk(params, cfg, chunked=None), prompts)
+        for target_chunked in (PAGE, None):
+            src = mk(params, cfg, chunked=PAGE)
+            ids = [src.submit(p, max_new=6) for p in prompts]
+            done = dict(src.step())          # long prompt now mid-prefill
+            assert any(d > 0 or len(src._slot_prompt[s]) > d
+                       for s, d in src._prefill_pending.items())
+            snap = ServingSnapshot.from_pytree(src.drain().to_pytree())
+            tgt = mk(params, cfg, chunked=target_chunked)
+            assert tgt.restore(snap) >= len(prompts) - len(done)
+            while tgt.pending:
+                done.update(tgt.step())
+            assert [done[i] for i in ids] == ref
+            tgt._alloc.assert_consistent()
+
+    def test_shed_absorb_mid_prefill(self, setup):
+        """Load shedding a MID-PREFILL slot: partial drain ships
+        lens = prefill_done, absorb re-queues the unprefilled tail on
+        the target, and the migrated stream stays byte-identical."""
+        cfg, params = setup
+        prompts = workload(cfg)[:3]
+        ref = drive(mk(params, cfg, chunked=None), prompts)
+        src, dst = mk(params, cfg, chunked=PAGE), mk(params, cfg,
+                                                     chunked=PAGE)
+        ids = [src.submit(p, max_new=6) for p in prompts]
+        done = dict(src.step())
+        shed = [s for s, d in src._prefill_pending.items()
+                if len(src._slot_prompt[s]) - d > PAGE]
+        assert shed, "a slot must still be mid-prefill"
+        mapping = dst.absorb(src.drain(slots=shed))
+        assert dst._prefill_pending, "absorb must re-queue the tail"
+        while src.pending:
+            done.update(src.step())
+        moved = {}
+        while dst.pending:
+            moved.update(dst.step())
+        out = [done[i] if i in done else moved[mapping[i]] for i in ids]
+        assert out == ref
+        src._alloc.assert_consistent()
+        dst._alloc.assert_consistent()
+
+
+class TestPressureMetrics:
+    def test_backlog_and_chunk_gauges(self, setup):
+        cfg, params = setup
+        eng = mk(params, cfg, chunked=PAGE)
+        assert eng.replica_stats()["prefill_backlog_tokens"] == 0
+        rng = np.random.default_rng(6)
+        eng.submit(rng.integers(0, cfg.vocab, 40), max_new=3)
+        eng.step()
+        st = eng.replica_stats()
+        assert st["prefill_backlog_tokens"] == 40 - PAGE
+        pm = eng.pool_metrics()
+        assert pm["prefill_backlog_tokens"] == 40 - PAGE
+        assert pm["prefill_chunks_total"] == 1.0
+        while eng.pending:
+            eng.step()
+        pm = eng.pool_metrics()
+        assert pm["prefill_backlog_tokens"] == 0
+        assert pm["prefill_chunks_total"] == 5.0   # ceil(40/8) chunks
+
+    def test_unchunked_engine_reports_zero(self, setup):
+        """Chunking off: the gauges exist (the fleet schema is uniform)
+        and stay 0/0 — admission dispatches whole prompts as before."""
+        cfg, params = setup
+        eng = mk(params, cfg, chunked=None)
+        rng = np.random.default_rng(7)
+        eng.submit(rng.integers(0, cfg.vocab, 20), max_new=2)
+        eng.step()
+        pm = eng.pool_metrics()
+        assert pm["prefill_backlog_tokens"] == 0.0
+        assert pm["prefill_chunks_total"] == 0.0
+
+    def test_prefill_chunk_phase_spans(self, setup):
+        """With a tracer attached, chunk dispatches record the
+        ``prefill_chunk`` phase — engine lane folded into the phase
+        batch (the Prometheus histogram feed), per-slot lanes for
+        Perfetto — and the per-request timeline shows the chunk walk."""
+        from k8s_gpu_scheduler_tpu.obs import Tracer
+
+        cfg, params = setup
+        tr = Tracer()
+        eng = mk(params, cfg, chunked=PAGE, tracer=tr)
+        rng = np.random.default_rng(8)
+        rid = eng.submit(rng.integers(0, cfg.vocab, 40), max_new=3,
+                         trace_id="chunky")
+        while eng.pending:
+            eng.step()
+        names = {s.name for s in tr.spans()}
+        assert "prefill_chunk" in names and "prefill" not in names
+        tl = eng.request_timeline(rid)
+        assert tl["phases"]["prefill_chunk"]["count"] == 5
+        phases = dict(eng.pool_metrics().get("phase_durations") or ())
+        assert "prefill_chunk" in phases

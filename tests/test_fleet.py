@@ -168,6 +168,15 @@ class TestScoring:
         s0, _ = r.score(subs["r0"], prompt)
         s1, _ = r.score(subs["r1"], prompt)
         assert s0 > s1
+        # Prefill backlog alone breaks an otherwise exact tie: a
+        # replica mid-way through chunking a long prompt looks free on
+        # the page/slot axes, so the backlog term must be what moves
+        # the next long prompt elsewhere.
+        subs = self.summaries()
+        subs["r0"].prefill_backlog_tokens = 512
+        s0, _ = r.score(subs["r0"], prompt)
+        s1, _ = r.score(subs["r1"], prompt)
+        assert s1 > s0
         # Exactly equal summaries -> the lowest replica id wins.
         fresh = self.router(setup)
         rid, policy, _ = fresh.route(prompt)
@@ -186,12 +195,14 @@ class TestScoring:
             "r0": [(prompts[0][:PAGE], PAGE)],
             "r1": [(prompts[1][:2 * PAGE], 2 * PAGE)],
         }
+        backlogs = {"r0": 96, "r1": 0}       # chunked-prefill pressure
 
         def placements():
             r = self.router(setup)
             for rid, s in self.summaries().items():
                 s.fleet = r.fleet
                 s.digest = digests[rid]
+                s.prefill_backlog_tokens = backlogs[rid]
                 s.published_wall = r._clock.wall()
                 publish_summary(r._store, s)
             return [r.route(p) for p in prompts]
@@ -207,6 +218,31 @@ class TestScoring:
         s_fast, _ = r.score(subs["r1"], [1, 2])
         s_slow, _ = r.score(slow, [1, 2])
         assert s_slow < s_fast
+
+    def test_prefill_backlog_pressure_discounts(self, setup):
+        """The chunked-prefill complement of the decode-p50 test: a
+        replica with admitted-but-unfinished prefill scores below an
+        idle twin, monotonically in the backlog, and a live mid-prefill
+        engine publishes the backlog in its summary."""
+        cfg, params = setup
+        r = self.router(setup)
+        subs = self.summaries()
+        idle, _ = r.score(subs["r1"], [1, 2])
+        mild, _ = r.score(dataclasses.replace(
+            subs["r1"], prefill_backlog_tokens=512), [1, 2])
+        flood, _ = r.score(dataclasses.replace(
+            subs["r1"], prefill_backlog_tokens=8192), [1, 2])
+        assert idle > mild > flood
+        eng = mk_engine(params, cfg, prefill_chunk_tokens=PAGE,
+                        max_len=128)
+        eng.submit(list(np.random.default_rng(9).integers(
+            0, cfg.vocab, 5 * PAGE)), max_new=4)
+        eng.step()
+        s = summarize(eng, "r0")
+        assert s.prefill_backlog_tokens == 4 * PAGE
+        while eng.pending:
+            eng.step()
+        assert summarize(eng, "r0").prefill_backlog_tokens == 0
 
 
 # -- partial drain / absorb ------------------------------------------------
@@ -408,9 +444,20 @@ class TestRouterEndToEnd:
                          ("r2", mk_engine(params, cfg))],
                         clock=clock, stale_s=1.0)
         assert router.route([1, 2, 3])[1] == "affinity"
+        # Fresh summaries: a prefill-flooded r0 loses the otherwise
+        # exact tie (the backlog discount steers around it).
+        s0 = summarize(router._replica("r0").engine, "r0",
+                       fleet=router.fleet, now_wall=clock.wall())
+        s0.prefill_backlog_tokens = 10_000
+        publish_summary(router._store, s0)
+        router._summaries_cache = None
+        assert router.route([1, 2, 3])[0] == "r1"
         clock.advance(5.0)                   # summaries now stale
         picks = [router.route([1, 2, 3]) for _ in range(4)]
         assert [p[1] for p in picks] == ["degraded"] * 4
+        # Degraded round-robin is pressure-blind BY DESIGN: the flooded
+        # r0 is back in rotation (bounded staleness degrades placement
+        # quality, never the deterministic fallback).
         assert [p[0] for p in picks] == ["r0", "r1", "r2", "r0"]
         assert router.stats()["degraded_routes"] == 4
         router.publish()                     # fresh summaries again

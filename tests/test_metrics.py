@@ -350,6 +350,36 @@ class TestServingPoolExport:
         assert "# HELP tpu_serve_last_step_age_seconds" in text
         assert set(snapshot) <= set(SERVING_POOL_GAUGES)
 
+    def test_chunked_prefill_gauges_exported(self):
+        """The chunked-prefill gauges ride the same map — the names are
+        the PR contract (tpu_serve_prefill_backlog_tokens /
+        tpu_serve_prefill_chunks_total) — and the prefill_chunk phase
+        folds into the phase histogram next to the pre-existing phases
+        without disturbing the unlabeled exposition."""
+        from k8s_gpu_scheduler_tpu.metrics import (
+            SERVING_POOL_GAUGES, export_serving_pool,
+        )
+        from k8s_gpu_scheduler_tpu.metrics.exporter import PHASE_HISTOGRAM
+
+        reg = Registry()
+        snapshot = {
+            "prefill_backlog_tokens": 384.0,
+            "prefill_chunks_total": 7.0,
+            "phase_durations": (("prefill_chunk", 0.004),
+                                ("decode_chunk", 0.002)),
+        }
+        export_serving_pool(reg, snapshot)
+        text = reg.expose()
+        assert "tpu_serve_prefill_backlog_tokens 384.0" in text
+        assert "tpu_serve_prefill_chunks_total 7.0" in text
+        assert "# HELP tpu_serve_prefill_backlog_tokens" in text
+        assert (PHASE_HISTOGRAM + '_count{phase="prefill_chunk"} 1') \
+            in text
+        assert (PHASE_HISTOGRAM + '_count{phase="decode_chunk"} 1') \
+            in text
+        assert {"prefill_backlog_tokens",
+                "prefill_chunks_total"} <= set(SERVING_POOL_GAUGES)
+
     def test_rpc_retry_counter_labels(self):
         """tpu_sched_rpc_retries_total{client=...}: the per-client retry
         counter the scheduler entrypoint wires into both control-plane
