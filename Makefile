@@ -42,6 +42,7 @@ bench-smoke:
 	$(PY) bench.py --leg chaos --smoke
 	$(PY) bench.py --leg obs_overhead --smoke
 	$(PY) bench.py --leg fleet --smoke
+	$(PY) bench.py --leg fleet_chaos --smoke
 	$(PY) bench.py --leg chunked_prefill --smoke
 	$(PY) bench.py --leg decode_attention --smoke
 

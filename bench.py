@@ -1715,6 +1715,177 @@ def bench_fleet(smoke=False):
     }
 
 
+def bench_fleet_chaos(smoke=False):
+    """Fleet crash-tolerance leg — the zero-loss contract of the
+    crash-tolerant router (fleet/health.py + fleet/journal.py +
+    deterministic-replay failover), measured: an open-loop Poisson trace
+    runs over 3 paged replicas while a SEEDED schedule hard-kills
+    replicas mid-trace (``replica.crash`` kind="crash": the engine
+    object is discarded — no drain, no snapshot; quarantined replicas
+    rejoin through the engine factory on a jittered backoff). The CI
+    asserts: every submitted request completes; every delivered stream
+    is byte-equal to the no-fault single-engine reference (journal
+    replay is token-identical, verify-window checked);
+    ``tpu_fleet_requests_lost_total == 0``; replayed (re-decoded verify)
+    tokens are bounded by journaled delivered tokens; and the whole
+    chaos run — kills, failovers, rejoins, streams — is
+    replay-deterministic (two runs, identical injector logs and
+    results). On CPU (or --smoke) the model is tiny/f32; the TPU run
+    under the driver is what BENCH_*.json captures."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_scheduler_tpu.fleet import HealthPolicy, Router
+    from k8s_gpu_scheduler_tpu.metrics.exporter import (
+        FLEET_FAILOVERS_TOTAL, FLEET_LOST_TOTAL,
+        FLEET_REPLAYED_TOKENS_TOTAL, Registry,
+    )
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+    from k8s_gpu_scheduler_tpu.testing.faults import (
+        FaultInjector, FaultRule,
+    )
+    from k8s_gpu_scheduler_tpu.utils.retry import RetryPolicy
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if smoke or not on_tpu:
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+        n_req, max_new, rate = 24, 10, 1.5
+        eng_kw = dict(n_slots=4, max_len=96, chunk=4, prefill_bucket=16,
+                      kv_layout="paged", page_size=8, prefix_cache=True)
+        crash_p, crash_until = 0.02, 60
+    else:
+        cfg = LlamaConfig(
+            vocab=32000, d_model=1024, n_layers=4, n_heads=16,
+            n_kv_heads=16, d_ff=4096, max_seq=2048, remat=False,
+            decode_attn="fused")
+        n_req, max_new, rate = 96, 48, 2.0
+        eng_kw = dict(n_slots=8, max_len=2048, chunk=8,
+                      prefill_bucket=128, kv_layout="paged", page_size=64,
+                      kv_dtype="int8", prefix_cache=True)
+        crash_p, crash_until = 0.01, 400
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    n_replicas, n_classes = 3, 3
+    page = eng_kw["page_size"]
+    rng = np.random.default_rng(0)
+    hot = [list(rng.integers(0, cfg.vocab, 2 * page))
+           for _ in range(n_classes)]
+    classes = [int(c) for c in rng.integers(0, n_classes, n_req)]
+    workload = [hot[c] + list(rng.integers(0, cfg.vocab, 3 + i % 7))
+                for i, c in enumerate(classes)]
+    arrive_step = np.floor(np.cumsum(
+        rng.exponential(1.0 / rate, n_req))).astype(int)
+
+    def factory(rid):
+        return ContinuousBatcher(params, cfg, **eng_kw)
+
+    # No-fault single-engine reference: greedy streams are
+    # placement-independent, so one engine's answers are the truth the
+    # chaos run must reproduce byte-for-byte.
+    ref_eng = factory("ref")
+    ids = [ref_eng.submit(p, max_new=max_new) for p in workload]
+    ref_done = {}
+    while ref_eng.pending:
+        ref_done.update(ref_eng.step())
+    ref = [ref_done[i] for i in ids]
+
+    def drive():
+        """One chaos run: fresh fleet, fresh injector, same seeds. The
+        router runs on a VirtualClock advanced a FIXED dt per step, so
+        quarantine expiry — and with it the serving set and the
+        injector's call sequence — is a pure function of the step
+        index, not of how fast this host executes a step (the first
+        drive pays JIT compiles the second doesn't; on wall clock the
+        two runs would disagree about how many steps a hold spans).
+        Returns everything the determinism gate compares."""
+        from k8s_gpu_scheduler_tpu.obs import VirtualClock
+
+        clock = VirtualClock()
+        inj = FaultInjector(seed=13, rules=[
+            # Seeded probabilistic kills while the trace is in flight —
+            # a pure function of (seed, call sequence), so two runs
+            # inject at the same points. The window keeps the tail of
+            # the run kill-free so rejoined replicas drain cleanly.
+            FaultRule(site="replica.crash", kind="crash", p=crash_p,
+                      until=crash_until),
+        ])
+        reg = Registry()
+        router = Router(
+            [(f"r{i}", factory(f"r{i}")) for i in range(n_replicas)],
+            metrics=reg, engine_factory=factory, faults=inj,
+            clock=clock,
+            health=HealthPolicy(quarantine=RetryPolicy(
+                attempts=8, base_s=0.05, multiplier=2.0, max_s=0.2,
+                jitter=0.5)),
+            health_seed=13)
+        frids, done = [], {}
+        nxt, t = 0, 0
+        t0 = time.perf_counter()
+        while nxt < n_req or router.pending:
+            while nxt < n_req and arrive_step[nxt] <= t:
+                frids.append(router.submit(workload[nxt],
+                                           max_new=max_new))
+                nxt += 1
+            done.update(router.step())
+            clock.advance(0.02)          # one step = 20 virtual ms
+            t += 1
+        wall = time.perf_counter() - t0
+        streams = [done.get(f) for f in frids]
+        st = router.stats()
+        lost = sum(reg.counter(FLEET_LOST_TOTAL).value(replica=f"r{i}")
+                   for i in range(n_replicas)) \
+            + reg.counter(FLEET_LOST_TOTAL).value()
+        failovers = sum(
+            reg.counter(FLEET_FAILOVERS_TOTAL).value(replica=f"r{i}")
+            for i in range(n_replicas))
+        replayed = reg.counter(FLEET_REPLAYED_TOKENS_TOTAL).value()
+        return (streams, list(inj.log), st, lost, failovers, replayed,
+                wall)
+
+    streams, log, st, lost, failovers, replayed, wall = drive()
+    streams2, log2, st2, lost2, _fo2, _rp2, _w2 = drive()
+
+    n_tok = sum(len(s) for s in streams if s)
+    extra = {
+        "fleet_chaos_shape": f"{n_replicas} replicas, {n_req} reqs over "
+                             f"{n_classes} hot {2 * page}-tok prompts, "
+                             f"max_new {max_new}, Poisson rate "
+                             f"{rate}/step, crash p={crash_p} "
+                             f"until={crash_until}",
+        "fleet_chaos_interpret": not on_tpu,
+        "fleet_chaos_tok_s": round(n_tok / wall, 1),
+        "fleet_chaos_completed": all(s is not None for s in streams),
+        "fleet_chaos_token_identity": streams == ref,
+        "fleet_chaos_requests_lost": lost,
+        "fleet_chaos_failovers": failovers,
+        "fleet_chaos_kills": sum(1 for s in log if s[2] == "crash"),
+        "fleet_chaos_replayed_tokens": replayed,
+        "fleet_chaos_delivered_tokens": st["journal_delivered_tokens"],
+        # Bounded rework: the re-decoded verify window can never exceed
+        # what the journal had delivered (per failover it is
+        # min(verify_tokens, delivered); summed it stays under the
+        # delivered total).
+        "fleet_chaos_replay_bounded":
+            replayed <= st["journal_delivered_tokens"],
+        "fleet_chaos_journal_inflight_end": st["journal_inflight"],
+        "fleet_chaos_deterministic": (streams == streams2
+                                      and log == log2
+                                      and lost == lost2
+                                      and st["failovers"]
+                                      == st2["failovers"]),
+    }
+    return {
+        "metric": "fleet_chaos_bench",
+        "value": failovers,
+        "unit": "failovers",
+        "extra": extra,
+    }
+
+
 def bench_chunked_prefill(smoke=False):
     """Chunked-prefill leg — the TTFT/decode-interference contract of
     ``ContinuousBatcher(prefill_chunk_tokens=...)``, measured: an
@@ -1946,13 +2117,16 @@ def main(argv=None):
         if leg == "fleet":
             print(json.dumps(bench_fleet(smoke="--smoke" in args)))
             return
+        if leg == "fleet_chaos":
+            print(json.dumps(bench_fleet_chaos(smoke="--smoke" in args)))
+            return
         if leg == "chunked_prefill":
             print(json.dumps(bench_chunked_prefill(smoke="--smoke" in args)))
             return
         raise SystemExit(f"unknown bench leg: {leg!r} (available: "
                          f"decode_attention, paged_attention, prefix_cache, "
                          f"speculative, analysis, chaos, obs_overhead, "
-                         f"fleet, chunked_prefill)")
+                         f"fleet, fleet_chaos, chunked_prefill)")
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
     # the bench measures the scheduler as deployed.
     sys.setswitchinterval(0.001)

@@ -1,5 +1,14 @@
-"""Fleet serving tier: cache-aware routing + snapshot load shedding
-over N ``ContinuousBatcher`` replicas (see router.py / summary.py)."""
+"""Fleet serving tier: cache-aware routing + snapshot load shedding +
+crash tolerance (replica health states, durable request journal,
+deterministic-replay failover) over N ``ContinuousBatcher`` replicas
+(see router.py / summary.py / health.py / journal.py)."""
+from .health import (
+    DEAD, HealthMonitor, HealthPolicy, LIVE, QUARANTINED, REJOINING,
+    ReplicaHealth, STATES, SUSPECT,
+)
+from .journal import (
+    DONE, ERROR, EXPIRED, JournalEntry, JournalError, RequestJournal,
+)
 from .router import FleetError, Router
 from .summary import (
     MemoryStore, ReplicaSummary, list_summaries, prefix_match_len,
@@ -7,10 +16,25 @@ from .summary import (
 )
 
 __all__ = [
+    "DEAD",
+    "DONE",
+    "ERROR",
+    "EXPIRED",
     "FleetError",
+    "HealthMonitor",
+    "HealthPolicy",
+    "JournalEntry",
+    "JournalError",
+    "LIVE",
     "MemoryStore",
+    "QUARANTINED",
+    "REJOINING",
+    "ReplicaHealth",
     "ReplicaSummary",
+    "RequestJournal",
     "Router",
+    "STATES",
+    "SUSPECT",
     "list_summaries",
     "prefix_match_len",
     "publish_summary",

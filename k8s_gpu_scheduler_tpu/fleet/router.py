@@ -1,5 +1,5 @@
 """Cache-aware fleet router — N serving replicas behind one admission
-point.
+point, crash-tolerant.
 
 One paged ``ContinuousBatcher`` is a replica, not a service; this module
 is the fleet tier the ROADMAP's "millions of users" story needs. The
@@ -22,22 +22,48 @@ from losing every request to a hot one; the latency term is the
 DistServe observation that decode-phase pressure (TPOT) is the thing
 co-placement hurts, so it is scored per-phase rather than folded into a
 scalar load average. The backlog term is the prefill-phase complement
-(chunked prefill, PR 9): admitted-but-unfinished prefill tokens are
-pressure the page/slot axes cannot see — a replica grinding through a
-long prompt's chunks holds few extra slots, so without the discount a
-long-prompt flood keeps landing on the same replica until its pool
-finally fills. When summaries are STALE (an unreachable registry,
-a wedged publisher — the bounded-retry clients of utils/retry.py fail
-fast rather than hang) routing degrades to deterministic round-robin:
-worse placement, zero additional risk.
+(chunked prefill, PR 9). When summaries are STALE routing degrades to
+deterministic round-robin: worse placement, zero additional risk.
 
-The second half is LOAD SHEDDING: ``shed()`` takes a partial
-``ServingSnapshot`` off a hot replica (``drain(slots=...)`` — a filter
-over ``slot_req``, not a new format) and ``absorb()``s it into a cold
-one, token-identically, re-pointing the router's fleet-level request
-ids through the returned rid mapping. Both engines' flight recorders
-log the handoff (``shed``/``absorb`` records), and
-``assert_consistent`` holds on both pools afterwards.
+LOAD SHEDDING (cooperative, PR 8): ``shed()`` takes a partial
+``ServingSnapshot`` off a hot replica (``drain(slots=...)``) and
+``absorb()``s it into a cold one, token-identically, re-pointing the
+router's fleet-level request ids through the returned rid mapping.
+
+CRASH TOLERANCE (this layer's non-cooperative half) rests on three
+pieces:
+
+- a **health monitor** (fleet/health.py): per-replica
+  ``live → suspect → dead → quarantined → rejoining`` driven by
+  isolated step exceptions, summary-heartbeat staleness, and the
+  engine's ``last_step_age`` watchdog, with a jittered-backoff
+  quarantine (circuit breaker) and rejoin through
+  ``models/lifecycle.py resume_or_fresh`` + an ``engine_factory``.
+- a **request journal** (fleet/journal.py): ``submit()`` records
+  ``(frid, prompt, max_new, trace_id, routed-to, deadline)`` and every
+  ``step()`` appends each in-flight request's delivered-token progress
+  (``ContinuousBatcher.emitted``); the journal is a pure-JSON/numpy
+  pytree that persists through ``utils/checkpoint.py``
+  (``checkpoint_journal()``) and survives a router restart.
+- **failover by deterministic replay**: a replica declared dead has its
+  engine object discarded (no drain — there is nobody to cooperate
+  with) and every journaled in-flight request re-submitted to a
+  surviving replica with ``prompt + delivered`` (minus a
+  ``replay_verify_tokens`` window) as the new prompt. Greedy decode is
+  deterministic, so the regenerated verify window must byte-equal the
+  journal (divergence is surfaced, never silently streamed) and only
+  the undelivered suffix streams to the caller: the end-to-end stream
+  is byte-identical to a no-fault run. The radix prefix cache makes the
+  replay prefill cheap where siblings share the prompt; chunked prefill
+  bounds its interference. Rework is bounded: re-decoded (verify)
+  tokens per failover ≤ journaled delivered tokens.
+
+Per-request deadlines (``submit(deadline_s=)``) are enforced at the
+router between steps: an expired request is cancelled on its engine
+(pages retired — ``ContinuousBatcher.cancel``), surfaced in
+``Router.errors`` (mirroring ``ContinuousBatcher.errors``), and its
+journal entry closed — never silently stuck. ``run()`` is bounded by a
+no-progress watchdog instead of spinning forever on a wedged fleet.
 
 Threading: the router is a single-threaded driver (one step loop owns
 all N engines — the same model the per-engine step loop already uses);
@@ -47,14 +73,26 @@ retry-bounded on its own.
 from __future__ import annotations
 
 from collections import OrderedDict, deque
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..metrics.exporter import (
-    FLEET_AFFINITY_HITS_TOTAL, FLEET_COUNTERS, FLEET_MIGRATED_TOTAL,
-    FLEET_ROUTED_TOTAL, FLEET_SHED_TOTAL, export_serving_pool,
+    FLEET_AFFINITY_HITS_TOTAL, FLEET_COUNTERS, FLEET_EXPIRED_TOTAL,
+    FLEET_FAILOVERS_TOTAL, FLEET_GAUGES, FLEET_JOURNAL_SIZE,
+    FLEET_LOST_TOTAL, FLEET_MIGRATED_TOTAL, FLEET_REPLAYED_TOKENS_TOTAL,
+    FLEET_REPLICA_STATE, FLEET_ROUTED_TOTAL, FLEET_SHED_TOTAL,
+    export_serving_pool,
+)
+from ..models.lifecycle import (
+    load_journal, persist_journal, resume_or_fresh,
 )
 from ..models.snapshot import SnapshotError, check_fingerprint
 from ..obs import SYSTEM_CLOCK
+from ..testing.faults import InjectedFault, ReplicaCrashed
+from .health import (
+    DEAD, HealthMonitor, HealthPolicy, LIVE, QUARANTINED, REJOINING,
+    STATES, SUSPECT,
+)
+from .journal import DONE, ERROR, EXPIRED, JournalError, RequestJournal
 from .summary import (
     MemoryStore, ReplicaSummary, list_summaries, prefix_match_len,
     publish_summary, summarize,
@@ -67,7 +105,7 @@ _PREFILL_PHASES = ("prefill", "prefill_chunk")
 
 class FleetError(RuntimeError):
     """Fleet-level misuse or impossible operation (unknown replica,
-    shed without capacity, heterogeneous fleet)."""
+    shed without capacity, heterogeneous fleet, no-progress watchdog)."""
 
 
 def _p50(window) -> float:
@@ -78,10 +116,13 @@ def _p50(window) -> float:
 
 
 class _Replica:
-    """Router-side state for one engine: identity, publish seq, and the
+    """Router-side state for one engine: identity, publish seq, the
     rolling phase-duration windows the summary p50s are computed from
     (fed by the same ``pool_metrics()`` phase batch the Prometheus
-    export consumes — drained once, used twice)."""
+    export consumes — drained once, used twice), and the health inputs
+    captured at publish time (heartbeat wall, watchdog age). ``engine``
+    is None while the replica is dead/quarantined — a hard crash
+    DISCARDS the object (no drain); rejoin installs a fresh one."""
 
     def __init__(self, replica_id: str, engine) -> None:
         self.id = replica_id
@@ -89,6 +130,8 @@ class _Replica:
         self.seq = 0
         self.decode_window: deque = deque(maxlen=256)
         self.prefill_window: deque = deque(maxlen=64)
+        self.last_publish_wall = float("-inf")   # heartbeat (store ack'd)
+        self.last_step_age = 0.0                 # watchdog (from publish)
 
 
 class Router:
@@ -104,8 +147,20 @@ class Router:
     point of this module) or ``"round_robin"`` (the baseline the bench
     leg beats). ``metrics`` is an optional metrics.exporter ``Registry``
     — when present every replica's ``pool_metrics()`` exports under a
-    ``{replica=}`` label and the ``tpu_fleet_*`` counters are kept.
-    """
+    ``{replica=}`` label and the ``tpu_fleet_*`` counters/gauges are
+    kept.
+
+    Crash-tolerance knobs: ``health`` (a :class:`HealthPolicy`;
+    thresholds + quarantine backoff), ``engine_factory`` (``rid -> new
+    engine`` — without it a dead replica can never rejoin and stays
+    quarantined), ``faults`` (a ``FaultInjector`` firing ``fleet.step``
+    once per router step and ``replica.crash`` once per serving replica
+    per step — kind="crash" hard-kills that replica), ``journal_dir``
+    (orbax home for ``checkpoint_journal()``; when it already holds a
+    journal the constructor recovers it and replays every open entry),
+    ``replay_verify_tokens`` (re-decoded overlap per failover — the
+    determinism check; 0 trusts the journal blindly),
+    ``run_no_progress_s`` (the ``run()`` watchdog horizon)."""
 
     def __init__(self, replicas: Sequence[Tuple[str, object]],
                  store=None, fleet: str = "fleet",
@@ -116,13 +171,24 @@ class Router:
                  backlog_ref_tokens: float = 2048.0,
                  auto_shed: bool = False,
                  shed_free_frac: float = 0.125,
-                 shed_target_free_frac: float = 0.5) -> None:
+                 shed_target_free_frac: float = 0.5,
+                 health: Optional[HealthPolicy] = None,
+                 health_seed: int = 0,
+                 engine_factory: Optional[Callable[[str], object]] = None,
+                 faults=None,
+                 journal_dir: Optional[str] = None,
+                 replay_verify_tokens: int = 4,
+                 run_no_progress_s: float = 30.0) -> None:
         if not replicas:
             raise FleetError("a fleet needs at least one replica")
         if policy not in ("affinity", "round_robin"):
             raise FleetError(
                 f"policy must be 'affinity' or 'round_robin', got "
                 f"{policy!r}")
+        if replay_verify_tokens < 0:
+            raise FleetError(
+                f"replay_verify_tokens must be >= 0, got "
+                f"{replay_verify_tokens}")
         self._replicas: "OrderedDict[str, _Replica]" = OrderedDict()
         first_id: Optional[str] = None
         for rid, eng in replicas:
@@ -140,7 +206,8 @@ class Router:
                 # mid-shed would strand the drained requests. With a
                 # homogeneous fleet (everything but n_pages must
                 # match — snapshot.check_fingerprint), absorb can only
-                # refuse for capacity, which shed() prechecks.
+                # refuse for capacity, which shed() prechecks. The same
+                # reference vets every rejoining engine.
                 try:
                     check_fingerprint(
                         self._replicas[first_id].engine.fingerprint(),
@@ -150,6 +217,8 @@ class Router:
                         f"replica {rid!r} is not shed-compatible with "
                         f"{first_id!r}: {e}") from e
             self._replicas[rid] = _Replica(rid, eng)
+        self._fingerprint_ref = \
+            self._replicas[first_id].engine.fingerprint()
         self.page_size = int(
             self._replicas[first_id].engine.replica_stats()["page_size"])
         self.fleet = str(fleet)
@@ -167,6 +236,11 @@ class Router:
         self.auto_shed = bool(auto_shed)
         self.shed_free_frac = float(shed_free_frac)
         self.shed_target_free_frac = float(shed_target_free_frac)
+        self.replay_verify_tokens = int(replay_verify_tokens)
+        self.run_no_progress_s = float(run_no_progress_s)
+        self._engine_factory = engine_factory
+        self._faults = faults
+        self._journal_dir = journal_dir
         if metrics is not None:
             self._c_routed = metrics.counter(
                 FLEET_ROUTED_TOTAL, FLEET_COUNTERS[FLEET_ROUTED_TOTAL])
@@ -177,38 +251,85 @@ class Router:
             self._c_affinity = metrics.counter(
                 FLEET_AFFINITY_HITS_TOTAL,
                 FLEET_COUNTERS[FLEET_AFFINITY_HITS_TOTAL])
+            self._c_failovers = metrics.counter(
+                FLEET_FAILOVERS_TOTAL, FLEET_COUNTERS[FLEET_FAILOVERS_TOTAL])
+            self._c_replayed = metrics.counter(
+                FLEET_REPLAYED_TOKENS_TOTAL,
+                FLEET_COUNTERS[FLEET_REPLAYED_TOKENS_TOTAL])
+            self._c_lost = metrics.counter(
+                FLEET_LOST_TOTAL, FLEET_COUNTERS[FLEET_LOST_TOTAL])
+            self._c_expired = metrics.counter(
+                FLEET_EXPIRED_TOTAL, FLEET_COUNTERS[FLEET_EXPIRED_TOTAL])
+        # Health: every replica starts live; transitions drive failover.
+        self._health = HealthMonitor(health, seed=health_seed)
+        for rid in self._replicas:
+            self._health.add(rid, now=self._clock.monotonic())
         # Fleet-level request ids: one namespace over all replicas —
         # local engine ids are replica-private and CHANGE on migration
         # (absorb assigns fresh ones), so callers hold fleet ids and the
-        # router re-points the mapping at each shed.
-        self._next_frid = 0
+        # router re-points the mapping at each shed/failover. The
+        # JOURNAL owns the namespace (ids must stay unique across a
+        # router restart).
+        self._journal = RequestJournal()
         self._where: Dict[int, Tuple[str, int]] = {}   # frid -> (rid, lrid)
         self._local: Dict[Tuple[str, int], int] = {}   # (rid, lrid) -> frid
+        # Engine tokens already consumed by the journal, per placement —
+        # the progress cursor (a replayed placement restarts at 0 and
+        # burns its verify window before delivering).
+        self._consumed: Dict[Tuple[str, int], int] = {}
+        # frid -> expected-but-not-yet-verified replay overlap.
+        self._verify: Dict[int, List[int]] = {}
         self._req_metrics: Dict[int, Dict[str, float]] = {}
+        # Surfaced request failures (deadline expiry, poison requests,
+        # replay divergence) — the fleet mirror of
+        # ``ContinuousBatcher.errors``: a request is never silently
+        # stuck or silently dropped; it finishes or it lands here.
+        self.errors: Dict[int, str] = {}
         self._rr = 0                                   # round-robin cursor
         self._degraded = 0                             # degraded routes
         self._store_errors = 0
+        self._failovers = 0
+        self._replayed_tokens = 0
+        self._lost = 0
+        self._expired = 0
         # Parsed-summary cache, valid for one publish cycle: routing a
         # burst of submits between steps re-reads/re-parses nothing —
         # publish() (the only writer this router knows about)
         # invalidates it, so a shared-registry peer's update is picked
         # up at the next publish boundary at the latest.
         self._summaries_cache: Optional[Dict[str, ReplicaSummary]] = None
+        if journal_dir:
+            recovered = load_journal(journal_dir)
+            if recovered is not None:
+                # Router restart: every open entry's engine state died
+                # with the old process — orphan them all and replay
+                # (same machinery as a replica death).
+                self._journal = recovered
+                for frid in self._journal.open_frids():
+                    self._journal.reassign(frid, None, failover=True)
         self.publish()                                 # summaries exist
+        self._place_orphans()                          # recovered entries
+        self._export_fleet_health()
 
     # -- summary plane -----------------------------------------------------
     def publish(self, replica_id: Optional[str] = None) -> None:
         """Publish summaries (one replica, or the whole fleet): drain
-        each engine's ``pool_metrics()`` once — feeding the rolling
-        phase windows AND, when a metrics registry is attached, the
-        ``{replica=}``-labeled Prometheus export — then write the
-        summary to the store. Store failures are counted and swallowed:
-        the registry client is retry-bounded, and an unreachable
-        summary plane must degrade routing, never kill serving."""
+        each live engine's ``pool_metrics()`` once — feeding the rolling
+        phase windows, the watchdog age, AND, when a metrics registry is
+        attached, the ``{replica=}``-labeled Prometheus export — then
+        write the summary to the store. A successful write is the
+        replica's HEARTBEAT (health staleness reads the ack wall clock).
+        Store failures are counted and swallowed: the registry client is
+        retry-bounded, and an unreachable summary plane must degrade
+        routing, never kill serving."""
         reps = ([self._replica(replica_id)] if replica_id is not None
                 else list(self._replicas.values()))
         for rep in reps:
+            if rep.engine is None or not self._health.serving(rep.id):
+                continue
             pm = rep.engine.pool_metrics()
+            rep.last_step_age = float(
+                pm.get("last_step_age_seconds", 0.0) or 0.0)
             for phase, seconds in pm.get("phase_durations") or ():
                 if phase in _DECODE_PHASES:
                     rep.decode_window.append(float(seconds))
@@ -229,6 +350,8 @@ class Router:
                 publish_summary(self._store, s)
             except Exception:  # noqa: BLE001 — summary plane down ≠ serving down
                 self._store_errors += 1
+            else:
+                rep.last_publish_wall = s.published_wall
         self._summaries_cache = None       # next route() re-reads once
 
     def summaries(self) -> Dict[str, ReplicaSummary]:
@@ -263,18 +386,29 @@ class Router:
                    / self.backlog_ref_tokens))
         return (1.0 + match) * load, match
 
+    def _routable_ids(self) -> List[str]:
+        return [rid for rid in self._replicas
+                if self._health.routable(rid)
+                and self._replicas[rid].engine is not None]
+
     def route(self, prompt: Sequence[int]) -> Tuple[str, str, int]:
         """Choose a replica for ``prompt``: returns
-        ``(replica id, policy used, prefix match tokens)``. Affinity
-        scoring needs FRESH summaries (published within ``stale_s`` of
-        now); with none fresh — or under ``policy="round_robin"`` — the
-        deterministic round-robin fallback places the request instead
-        (bounded staleness can degrade placement quality, never
-        correctness)."""
+        ``(replica id, policy used, prefix match tokens)``. Only LIVE
+        replicas are candidates (suspect ones keep serving what they
+        hold but take no new blast radius). Affinity scoring needs FRESH
+        summaries (published within ``stale_s`` of now); with none
+        fresh — or under ``policy="round_robin"`` — the deterministic
+        round-robin fallback places the request instead (bounded
+        staleness can degrade placement quality, never correctness)."""
+        ids = self._routable_ids()
+        if not ids:
+            raise FleetError(
+                f"no live replicas to route to "
+                f"(states: {self._health.counts()})")
         if self.policy == "affinity":
             now = self._clock.wall()
             fresh = {r: s for r, s in self.summaries().items()
-                     if now - s.published_wall <= self.stale_s}
+                     if r in ids and now - s.published_wall <= self.stale_s}
             if fresh:
                 best_rid, best_score, best_match = None, 0.0, 0
                 for rid in sorted(fresh):
@@ -283,7 +417,6 @@ class Router:
                         best_rid, best_score, best_match = rid, sc, match
                 return best_rid, "affinity", best_match
             self._degraded += 1
-        ids = list(self._replicas)
         rid = ids[self._rr % len(ids)]
         self._rr += 1
         return rid, ("round_robin" if self.policy == "round_robin"
@@ -291,17 +424,38 @@ class Router:
 
     # -- serving API -------------------------------------------------------
     def submit(self, prompt, max_new: int,
-               trace_id: Optional[str] = None) -> int:
+               trace_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Route and admit one request; returns its FLEET id (stable
-        across migrations — local engine ids are not)."""
+        across migrations and failovers — local engine ids are not).
+        The submission is journaled BEFORE it reaches an engine: from
+        here on a crash anywhere in the fleet can delay the stream but
+        not lose it. ``deadline_s`` (relative seconds) arms per-request
+        deadline enforcement: past it the request fails with a surfaced
+        ``Router.errors`` record instead of sitting stuck."""
         prompt = [int(t) for t in prompt]
+        if deadline_s is not None and deadline_s <= 0:
+            raise FleetError(
+                f"deadline_s must be positive, got {deadline_s}")
         rid, policy, match = self.route(prompt)
+        now_wall = self._clock.wall()
+        frid = self._journal.open(
+            prompt, max_new, trace_id=trace_id, replica=rid,
+            deadline_wall=(None if deadline_s is None
+                           else now_wall + float(deadline_s)),
+            submitted_wall=now_wall)
         eng = self._replica(rid).engine
-        lrid = eng.submit(prompt, max_new=max_new, trace_id=trace_id)
-        frid = self._next_frid
-        self._next_frid += 1
+        try:
+            lrid = eng.submit(prompt, max_new=max_new, trace_id=trace_id)
+        except Exception:
+            # Admission refused (infeasible request) — the journal must
+            # not carry an entry no engine holds, or the failover path
+            # would replay a request that was never accepted.
+            self._journal.close(frid, ERROR)
+            raise
         self._where[frid] = (rid, lrid)
         self._local[(rid, lrid)] = frid
+        self._consumed[(rid, lrid)] = 0
         if self._metrics is not None:
             self._c_routed.inc(replica=rid, policy=policy)
             if match:
@@ -315,45 +469,443 @@ class Router:
 
     def locate(self, frid: int) -> Tuple[str, int]:
         """(replica id, local request id) a fleet request currently
-        lives on — moves when a shed migrates it."""
+        lives on — moves when a shed or a failover migrates it."""
         if frid not in self._where:
             raise FleetError(f"unknown or finished fleet request {frid}")
         return self._where[frid]
 
     @property
     def pending(self) -> int:
-        return sum(r.engine.pending for r in self._replicas.values())
+        """In-flight work: live engines' queues/slots plus journaled
+        orphans awaiting a live target (their dead replica's engine no
+        longer counts them — the journal does)."""
+        live = sum(r.engine.pending for r in self._replicas.values()
+                   if r.engine is not None
+                   and self._health.serving(r.id))
+        return live + len(self._journal.inflight_on(None))
 
+    # -- journal bookkeeping -----------------------------------------------
+    def _ingest(self, frid: int, tokens,
+                consumed: int) -> Optional[str]:
+        """Feed one placement's engine-token progress into the journal:
+        ``tokens[consumed:]`` first burns the replay verify window
+        (byte-compare against the journaled delivery — greedy decode is
+        deterministic, so a mismatch means the replay is NOT the same
+        stream and must fail loudly), the rest is newly delivered.
+        Returns a failure reason (caller fails THAT request — one bad
+        stream must not unwind the fleet step) or None on success."""
+        new = [int(t) for t in tokens[consumed:]]
+        if not new:
+            return None
+        expect = self._verify.get(frid)
+        if expect:
+            k = min(len(new), len(expect))
+            if new[:k] != expect[:k]:
+                return ("replay divergence: regenerated tokens != "
+                        "journaled delivery")
+            del expect[:k]
+            if not expect:
+                self._verify.pop(frid, None)
+            self._replayed_tokens += k
+            if self._metrics is not None:
+                self._c_replayed.inc(k)
+            new = new[k:]
+        if new:
+            try:
+                self._journal.deliver(frid, new)
+            except JournalError as e:
+                return f"journal refused delivery: {e}"
+        return None
+
+    def _drop_placement(self, frid: int) -> Optional[Tuple[str, int]]:
+        loc = self._where.pop(frid, None)
+        if loc is not None:
+            self._local.pop(loc, None)
+            self._consumed.pop(loc, None)
+        self._verify.pop(frid, None)
+        return loc
+
+    def _fail_fleet_request(self, frid: int, reason: str,
+                            outcome: str = ERROR,
+                            cancel: bool = True) -> None:
+        """Surface one fleet request's failure: engine-side cancel
+        (pages retired), ``Router.errors`` record, journal entry
+        closed."""
+        loc = self._drop_placement(frid)
+        if cancel and loc is not None:
+            rep = self._replicas.get(loc[0])
+            if rep is not None and rep.engine is not None:
+                try:
+                    rep.engine.cancel(loc[1], reason=reason)
+                except Exception:  # noqa: BLE001 — engine may be dying too
+                    pass
+        self.errors[frid] = reason
+        if frid in self._journal:
+            self._journal.close(frid, outcome)
+
+    def _collect_engine_errors(self, rep: _Replica) -> None:
+        """Mirror per-request engine failures (poison isolation,
+        ``ContinuousBatcher.errors``) into fleet errors + journal
+        closure — the request already lost its slot and pages."""
+        errs = rep.engine.errors
+        if not errs:
+            return
+        for (rid_, lrid), frid in list(self._local.items()):
+            if rid_ == rep.id and lrid in errs:
+                reason = errs[lrid]
+                self._drop_placement(frid)
+                self.errors[frid] = reason
+                if frid in self._journal:
+                    self._journal.close(frid, ERROR)
+
+    # -- health / failover -------------------------------------------------
+    def _note_transition(self, rid: str,
+                         transition: Optional[Tuple[str, str]],
+                         reason: str = "") -> None:
+        if transition is None:
+            return
+        old, new = transition
+        if self._tracer is not None:
+            name = "replica_dead" if new == DEAD else f"replica_{new}"
+            self._tracer.event(name, lane="router", replica=rid,
+                               prev=old, reason=reason)
+
+    def _crash(self, rid: str, exc: BaseException) -> None:
+        """Hard kill: the engine object is discarded — no drain, no
+        snapshot, exactly what an OOM/wedged-device/killed-pod leaves
+        behind. Recovery is journal replay only."""
+        rep = self._replica(rid)
+        rep.engine = None
+        now = self._clock.monotonic()
+        tr = self._health.declare_dead(rid, f"crash: {exc}", now)
+        self._note_transition(rid, tr, f"crash: {exc}")
+        self._on_dead(rid)
+
+    def _on_dead(self, rid: str) -> None:
+        """A replica was declared dead: discard its engine, orphan its
+        journaled in-flight requests (replayed onto survivors by
+        ``_place_orphans``), quarantine it (circuit breaker), and
+        account the failover. Requests a dead replica held WITHOUT a
+        journal entry would be lost — that counter must stay 0 (every
+        router submission is journaled at admission)."""
+        now = self._clock.monotonic()
+        rep = self._replica(rid)
+        rep.engine = None                  # dead = discarded, uniformly
+        orphaned = 0
+        for (rid_, _lrid), frid in list(self._local.items()):
+            if rid_ != rid:
+                continue
+            self._drop_placement(frid)
+            if frid in self._journal:
+                self._journal.reassign(frid, None, failover=True)
+                orphaned += 1
+            else:
+                self._lost += 1
+                if self._metrics is not None:
+                    self._c_lost.inc(replica=rid)
+        self._failovers += 1
+        if self._metrics is not None:
+            self._c_failovers.inc(replica=rid)
+        if self._tracer is not None:
+            self._tracer.event("failover", lane="router", replica=rid,
+                               orphaned=orphaned,
+                               reason=self._health.get(rid).last_error)
+        tr = self._health.quarantine(rid, now)
+        self._note_transition(rid, tr)
+        self._place_orphans()
+
+    def _replay_entry(self, entry) -> bool:
+        """Re-place one journaled in-flight request by deterministic
+        replay: the new prompt is ``prompt + delivered`` minus the
+        verify window, the new budget is the undelivered remainder plus
+        that window. The replayed stream's first ``overlap`` tokens must
+        byte-equal the journal (checked incrementally in ``_ingest``);
+        only tokens past the window are delivered — the caller's stream
+        is byte-identical to a no-fault run, and the re-decoded rework
+        is bounded by the journaled delivery."""
+        overlap = min(self.replay_verify_tokens, len(entry.delivered))
+        ctx = list(entry.prompt) + list(entry.delivered)
+        replay_prompt = ctx[:len(ctx) - overlap] if overlap else ctx
+        budget = entry.remaining + overlap
+        # Prefer the scored route (prefix affinity makes the replay
+        # prefill cheap where siblings share the prompt), then fall
+        # back to every other live replica — capacity refusals must not
+        # strand a request that some replica could hold.
+        try:
+            first, _policy, _match = self.route(replay_prompt)
+        except FleetError:
+            return False                   # no live replicas right now
+        candidates = [first] + [r for r in self._routable_ids()
+                                if r != first]
+        for rid in candidates:
+            eng = self._replicas[rid].engine
+            try:
+                lrid = eng.submit(replay_prompt, max_new=budget,
+                                  trace_id=entry.trace_id)
+            except (ValueError, RuntimeError):
+                continue                   # can't fit here; try the next
+            frid = entry.frid
+            self._where[frid] = (rid, lrid)
+            self._local[(rid, lrid)] = frid
+            self._consumed[(rid, lrid)] = 0
+            if overlap:
+                self._verify[frid] = list(entry.delivered[-overlap:])
+            self._journal.reassign(frid, rid)
+            if self._tracer is not None:
+                self._tracer.event(
+                    "replay", lane="router",
+                    rid=(entry.trace_id if entry.trace_id is not None
+                         else f"fleet-{frid}"),
+                    replica=rid, resumed_at=len(entry.delivered),
+                    verify_tokens=overlap)
+            flight = getattr(eng, "_flight", None)
+            if flight is not None:
+                flight.record("replay", frid=frid, lrid=lrid,
+                              resumed_at=len(entry.delivered),
+                              verify_tokens=overlap)
+            return True
+        return False
+
+    def _place_orphans(self) -> int:
+        """Replay every journaled request with no live placement (dead
+        replica, router restart). Unplaceable entries stay orphaned and
+        are retried each step — ``run()``'s no-progress watchdog bounds
+        the wait."""
+        placed = 0
+        for entry in self._journal.inflight_on(None):
+            if self._replay_entry(entry):
+                placed += 1
+        return placed
+
+    def _tick_health(self) -> None:
+        """Passive health pass, once per step: quarantine expiry →
+        rejoin (fresh engine via ``engine_factory`` +
+        ``resume_or_fresh``, fingerprint-vetted, failure re-quarantines
+        on the next backoff rung), then heartbeat-staleness and watchdog
+        checks over the publish-time captures. Staleness only indicts a
+        replica when the summary PLANE is alive (some other replica
+        published fresh): a dead store degrades routing (PR 8), it does
+        not kill the fleet."""
+        now = self._clock.monotonic()
+        now_wall = self._clock.wall()
+        for rep in self._replicas.values():
+            st = self._health.state(rep.id)
+            if st == QUARANTINED \
+                    and self._health.due_for_rejoin(rep.id, now) \
+                    and self._engine_factory is not None:
+                tr = self._health.start_rejoin(rep.id, now)
+                self._note_transition(rep.id, tr)
+                try:
+                    eng, _resumed = resume_or_fresh(
+                        lambda: self._engine_factory(rep.id),
+                        self._rejoin_dir(rep.id))
+                    eng.replica_stats()          # paged + alive probe
+                    check_fingerprint(self._fingerprint_ref,
+                                      eng.fingerprint())
+                except Exception as e:  # noqa: BLE001 — rejoin must not kill the fleet
+                    rep.engine = None
+                    tr = self._health.rejoin_failed(rep.id, e, now)
+                    self._note_transition(rep.id, tr, str(e))
+                    continue
+                rep.engine = eng
+                rep.last_step_age = 0.0
+                # Fresh heartbeat baseline BEFORE the publish attempt: a
+                # replica that died by staleness still carries its
+                # pre-death publish wall, and one dropped store write at
+                # rejoin time must not let the next observe() pass
+                # re-declare the healthy rebuild dead in the same tick.
+                rep.last_publish_wall = now_wall
+                tr = self._health.rejoined(rep.id, now)
+                self._note_transition(rep.id, tr)
+                self.publish(rep.id)             # heartbeat + summary
+                self._place_orphans()            # capacity came back
+        serving = [rep for rep in self._replicas.values()
+                   if self._health.serving(rep.id)]
+        ages = {rep.id: now_wall - rep.last_publish_wall
+                for rep in serving}
+        plane_ok = any(a <= self._health.policy.stale_s
+                       for a in ages.values())
+        for rep in serving:
+            tr = self._health.observe(
+                rep.id, now,
+                heartbeat_age_s=(ages[rep.id] if plane_ok else None),
+                last_step_age_s=rep.last_step_age)
+            self._note_transition(rep.id, tr)
+            if self._health.state(rep.id) == DEAD:
+                self._on_dead(rep.id)
+
+    def _rejoin_dir(self, rid: str) -> Optional[str]:
+        """Snapshot directory a rejoining replica may resume from —
+        None in-process (a hard crash never drained; resume_or_fresh
+        then builds fresh). A cross-process deployment points this at
+        the replica's pod volume."""
+        return None
+
+    def _enforce_deadlines(self) -> None:
+        now_wall = self._clock.wall()
+        for frid in self._journal.open_frids():
+            e = self._journal.entry(frid)
+            if e.deadline_wall is None or now_wall < e.deadline_wall:
+                continue
+            self._expired += 1
+            if self._metrics is not None:
+                self._c_expired.inc()
+            if self._tracer is not None:
+                self._tracer.event(
+                    "deadline_expired", lane="router",
+                    rid=(e.trace_id if e.trace_id is not None
+                         else f"fleet-{frid}"),
+                    delivered=len(e.delivered), budget=e.max_new)
+            self._fail_fleet_request(
+                frid,
+                f"deadline exceeded after "
+                f"{now_wall - e.submitted_wall:.3f}s "
+                f"({len(e.delivered)}/{e.max_new} tokens delivered)",
+                outcome=EXPIRED)
+
+    def _export_fleet_health(self) -> None:
+        if self._metrics is None:
+            return
+        g_state = self._metrics.gauge(FLEET_REPLICA_STATE,
+                                      FLEET_GAUGES[FLEET_REPLICA_STATE])
+        for rid in self._replicas:
+            st = self._health.state(rid)
+            for s in STATES:
+                g_state.set(1.0 if s == st else 0.0,
+                            replica=rid, state=s)
+        self._metrics.gauge(
+            FLEET_JOURNAL_SIZE,
+            FLEET_GAUGES[FLEET_JOURNAL_SIZE]).set(float(len(self._journal)))
+
+    # -- stepping ----------------------------------------------------------
     def step(self) -> Dict[int, list]:
-        """Step every replica once (admission + one decode/verify chunk
-        each), refresh the published summaries, and return the newly
-        finished streams keyed by FLEET id. With ``auto_shed`` on, a
+        """Step every serving replica once (admission + one
+        decode/verify chunk each) WITH per-replica fault isolation: one
+        replica's raise marks it suspect/dead and the step continues —
+        the bugfix for the old all-or-nothing unwind — then journal the
+        progress, enforce deadlines, replay orphans, refresh the
+        published summaries, and return the newly finished streams keyed
+        by FLEET id (each the full journaled delivery — for a
+        failed-over request that is pre-crash tokens + replayed suffix,
+        byte-identical to the no-fault stream). With ``auto_shed`` on, a
         replica past the pressure watermark sheds toward the coldest
         peer after the step."""
         done: Dict[int, list] = {}
-        for rep in self._replicas.values():
-            if not rep.engine.pending:
+        if self._faults is not None:
+            try:
+                self._faults.fire("fleet.step")
+            except ReplicaCrashed:
+                raise                      # a router crash is the driver's
+            except InjectedFault:
+                return done                # router step dropped: no work
+        self._tick_health()
+        now = self._clock.monotonic()
+        for rep in list(self._replicas.values()):
+            if rep.engine is None or not self._health.serving(rep.id):
                 continue
-            finished = rep.engine.step()
+            if self._faults is not None:
+                try:
+                    self._faults.fire("replica.crash",
+                                      drop_exc=ReplicaCrashed)
+                except InjectedFault as e:
+                    self._crash(rep.id, e)
+                    continue
+            if not rep.engine.pending:
+                # An idle engine cannot be wedged; a suspect one
+                # redeems itself by having nothing to fail at.
+                self._health.note_ok(rep.id, now)
+                continue
+            try:
+                finished = rep.engine.step()
+            except Exception as e:  # noqa: BLE001 — per-replica isolation (the point)
+                tr = self._health.note_error(rep.id, e, now)
+                self._note_transition(rep.id, tr, str(e))
+                if self._health.state(rep.id) == DEAD:
+                    self._on_dead(rep.id)
+                continue
+            self._health.note_ok(rep.id, now)
             metrics = rep.engine.pop_request_metrics()
+            self._collect_engine_errors(rep)
             for lrid, toks in finished.items():
                 frid = self._local.pop((rep.id, lrid), None)
                 if frid is None:
                     continue                 # not router-owned (warmup)
                 self._where.pop(frid, None)
-                done[frid] = toks
+                consumed = self._consumed.pop((rep.id, lrid), 0)
+                reason = self._ingest(frid, toks, consumed)
+                if reason is not None:
+                    self._fail_fleet_request(frid, reason, cancel=False)
+                    continue
+                if self._verify.get(frid):
+                    # Finished with verify window left unregenerated: a
+                    # correct replay's budget (remaining + window) always
+                    # regenerates the full window plus at least one new
+                    # token, so stopping short IS divergence (e.g. an
+                    # eos the journaled stream never contained) — fail
+                    # loudly, never close DONE with a truncated stream.
+                    self._fail_fleet_request(
+                        frid, "replay divergence: replayed stream ended "
+                        "inside the verify window", cancel=False)
+                    continue
+                done[frid] = self._journal.stream(frid)
+                self._journal.close(frid, DONE)
                 if lrid in metrics:
                     self._req_metrics[frid] = metrics[lrid]
+            for (rid_, lrid), frid in list(self._local.items()):
+                if rid_ != rep.id:
+                    continue
+                toks = rep.engine.emitted(lrid)
+                consumed = self._consumed.get((rid_, lrid), 0)
+                if len(toks) <= consumed:
+                    continue
+                reason = self._ingest(frid, toks, consumed)
+                if reason is not None:
+                    self._fail_fleet_request(frid, reason)
+                    continue
+                self._consumed[(rid_, lrid)] = len(toks)
+        self._enforce_deadlines()
+        self._place_orphans()
         self.publish()
+        self._export_fleet_health()
         if self.auto_shed:
             self.maybe_shed()
         return done
 
-    def run(self) -> Dict[int, list]:
-        """Drain everything submitted across the fleet."""
+    def _progress_marker(self) -> Tuple:
+        # Deliberately NOT health.transition_count: a replica flapping
+        # suspect↔live would register as perpetual "progress" and defeat
+        # the watchdog. Recovery that matters shows up here anyway — a
+        # rejoin that re-places orphans moves journal/pending.
+        return (self._journal.delivered_tokens_total,
+                sum(self._journal.closed.values()),
+                len(self._journal), self.pending)
+
+    def run(self, no_progress_s: Optional[float] = None) -> Dict[int, list]:
+        """Drain everything submitted across the fleet, bounded by a
+        no-progress watchdog: ``while pending`` alone would spin forever
+        on a wedged or permanently-quarantined fleet — if no token is
+        delivered, no request closes, and the journaled/pending work
+        doesn't move for ``no_progress_s`` (monotonic), raise instead.
+        A rejoin that matters re-places orphans (journal/pending move),
+        so a recovering fleet is never killed mid-backoff as long as
+        the horizon exceeds the quarantine ladder."""
+        horizon = (self.run_no_progress_s if no_progress_s is None
+                   else float(no_progress_s))
         done: Dict[int, list] = {}
+        last_progress = self._clock.monotonic()
+        marker = self._progress_marker()
         while self.pending:
             done.update(self.step())
+            now = self._clock.monotonic()
+            m = self._progress_marker()
+            if m != marker:
+                marker, last_progress = m, now
+            elif now - last_progress >= horizon:
+                raise FleetError(
+                    f"fleet made no progress for {now - last_progress:.1f}s: "
+                    f"{self.pending} pending, "
+                    f"{len(self._journal)} journaled in flight, "
+                    f"states {self._health.counts()}")
         return done
 
     def pop_request_metrics(self) -> Dict[int, Dict[str, float]]:
@@ -363,6 +915,25 @@ class Router:
         with the handoff gap charged (absorb rebases the clocks)."""
         out, self._req_metrics = self._req_metrics, {}
         return out
+
+    # -- durability --------------------------------------------------------
+    @property
+    def journal(self) -> RequestJournal:
+        return self._journal
+
+    def checkpoint_journal(self) -> None:
+        """Persist the journal under ``journal_dir`` via orbax
+        (models/lifecycle.py): a restarted router recovers it and
+        replays every open entry — the request-level analogue of the
+        serve loop's snapshot persistence, for the crash that never
+        drained."""
+        if not self._journal_dir:
+            raise FleetError("router built without journal_dir")
+        persist_journal(self._journal, self._journal_dir)
+
+    @property
+    def health(self) -> HealthMonitor:
+        return self._health
 
     # -- load shedding -----------------------------------------------------
     def _replica(self, rid: str) -> _Replica:
@@ -381,9 +952,17 @@ class Router:
         is prechecked on the target (free slots AND free pages) so the
         shed either moves everything or moves nothing. Returns the
         number of migrated requests."""
-        if str(src) == str(dst):
+        src, dst = str(src), str(dst)
+        if src == dst:
             raise FleetError("shed needs two distinct replicas")
-        se, de = self._replica(src).engine, self._replica(dst).engine
+        src_rep, dst_rep = self._replica(src), self._replica(dst)
+        if src_rep.engine is None or not self._health.serving(src):
+            raise FleetError(f"shed source {src!r} is not serving "
+                             f"({self._health.state(src)})")
+        if dst_rep.engine is None or not self._health.serving(dst):
+            raise FleetError(f"shed target {dst!r} is not serving "
+                             f"({self._health.state(dst)})")
+        se, de = src_rep.engine, dst_rep.engine
         active = se.active_slot_ids()
         if slots is None:
             n = max(1, len(active) // 2)
@@ -418,6 +997,13 @@ class Router:
                 new_key = (str(dst), mapping[lrid])
                 self._local[new_key] = frid
                 self._where[frid] = new_key
+                # The delivered-progress cursor rides along: absorb
+                # carries the emitted stream, so the target's emitted()
+                # continues at the same offset.
+                self._consumed[new_key] = self._consumed.pop(
+                    (rid, lrid), 0)
+                if frid in self._journal:
+                    self._journal.reassign(frid, str(dst))
                 moved += 1
         if self._metrics is not None:
             self._c_migrated.inc(len(mapping), replica=str(dst))
@@ -438,7 +1024,8 @@ class Router:
         Returns migrated requests (0 when no pair qualifies or the
         conservative capacity precheck refuses)."""
         stats = {rid: rep.engine.replica_stats()
-                 for rid, rep in self._replicas.items()}
+                 for rid, rep in self._replicas.items()
+                 if rep.engine is not None and self._health.serving(rid)}
 
         def frac(st):
             return st["pages_free"] / st["pages_total"] \
@@ -463,14 +1050,18 @@ class Router:
     # -- introspection -----------------------------------------------------
     def stats(self) -> Dict[str, object]:
         """Router-level counters + per-replica aggregate prefix stats —
-        what the fleet bench leg reports."""
+        what the fleet bench legs report."""
         per = {}
         hit = looked = 0.0
         for rid, rep in self._replicas.items():
+            if rep.engine is None:
+                per[rid] = {"state": self._health.state(rid)}
+                continue
             pm = rep.engine.pool_metrics()
             hit += pm.get("prefix_hit_tokens", 0.0)
             looked += pm.get("prefix_lookup_tokens", 0.0)
             per[rid] = {
+                "state": self._health.state(rid),
                 "pages_free": pm.get("pages_free", 0.0),
                 "active_slots": len(rep.engine.active_slot_ids()),
                 "prefix_hit_tokens": pm.get("prefix_hit_tokens", 0.0),
@@ -485,4 +1076,11 @@ class Router:
             "aggregate_prefix_hit_rate": hit / looked if looked else 0.0,
             "degraded_routes": self._degraded,
             "store_errors": self._store_errors,
+            "health_states": self._health.counts(),
+            "failovers": self._failovers,
+            "replayed_tokens": self._replayed_tokens,
+            "requests_lost": self._lost,
+            "deadline_expired": self._expired,
+            "journal_inflight": len(self._journal),
+            "journal_delivered_tokens": self._journal.delivered_tokens_total,
         }

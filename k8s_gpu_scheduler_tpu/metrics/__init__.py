@@ -16,8 +16,11 @@ from .client import (
 )
 from .exporter import (
     Counter, FLEET_AFFINITY_HITS_TOTAL, FLEET_COUNTERS,
-    FLEET_MIGRATED_TOTAL, FLEET_ROUTED_TOTAL, FLEET_SHED_TOTAL, Gauge,
-    Histogram, MetricsServer, PHASE_BUCKETS, PHASE_HISTOGRAM, Registry,
+    FLEET_EXPIRED_TOTAL, FLEET_FAILOVERS_TOTAL, FLEET_GAUGES,
+    FLEET_JOURNAL_SIZE, FLEET_LOST_TOTAL, FLEET_MIGRATED_TOTAL,
+    FLEET_REPLAYED_TOKENS_TOTAL, FLEET_REPLICA_STATE,
+    FLEET_ROUTED_TOTAL, FLEET_SHED_TOTAL, Gauge, Histogram,
+    MetricsServer, PHASE_BUCKETS, PHASE_HISTOGRAM, Registry,
     SERVING_POOL_GAUGES, export_serving_pool,
 )
 
@@ -35,7 +38,14 @@ __all__ = [
     "Counter",
     "FLEET_AFFINITY_HITS_TOTAL",
     "FLEET_COUNTERS",
+    "FLEET_EXPIRED_TOTAL",
+    "FLEET_FAILOVERS_TOTAL",
+    "FLEET_GAUGES",
+    "FLEET_JOURNAL_SIZE",
+    "FLEET_LOST_TOTAL",
     "FLEET_MIGRATED_TOTAL",
+    "FLEET_REPLAYED_TOKENS_TOTAL",
+    "FLEET_REPLICA_STATE",
     "FLEET_ROUTED_TOTAL",
     "FLEET_SHED_TOTAL",
     "Gauge",

@@ -326,6 +326,17 @@ FLEET_ROUTED_TOTAL = "tpu_fleet_routed_requests_total"
 FLEET_SHED_TOTAL = "tpu_fleet_shed_requests_total"
 FLEET_MIGRATED_TOTAL = "tpu_fleet_migrated_requests_total"
 FLEET_AFFINITY_HITS_TOTAL = "tpu_fleet_prefix_affinity_hits_total"
+# Crash tolerance (fleet/health.py + fleet/journal.py): failovers =
+# dead-replica declarations that replayed journaled requests; replayed
+# tokens = the redundant re-decoded verify window per failover (bounded
+# by journaled delivered tokens — the chaos CI leg asserts it); lost =
+# requests that vanished without a journal record (MUST stay 0 — the
+# zero-loss contract); expired = per-request deadlines enforced at the
+# router (submit(deadline_s=)).
+FLEET_FAILOVERS_TOTAL = "tpu_fleet_failovers_total"
+FLEET_REPLAYED_TOKENS_TOTAL = "tpu_fleet_replayed_tokens_total"
+FLEET_LOST_TOTAL = "tpu_fleet_requests_lost_total"
+FLEET_EXPIRED_TOTAL = "tpu_fleet_deadline_expired_total"
 FLEET_COUNTERS = {
     FLEET_ROUTED_TOTAL:
         "requests admitted through the fleet router, by replica/policy",
@@ -336,6 +347,31 @@ FLEET_COUNTERS = {
     FLEET_AFFINITY_HITS_TOTAL:
         "routed requests whose chosen replica had a non-zero cached "
         "prefix match",
+    FLEET_FAILOVERS_TOTAL:
+        "replica deaths whose in-flight requests were replayed onto "
+        "survivors, by (dead) replica",
+    FLEET_REPLAYED_TOKENS_TOTAL:
+        "journaled tokens re-decoded for replay verification "
+        "(bounded rework: <= delivered tokens per failover)",
+    FLEET_LOST_TOTAL:
+        "requests lost without a journal record (zero-loss contract: "
+        "must stay 0)",
+    FLEET_EXPIRED_TOTAL:
+        "requests failed at the router for exceeding their deadline",
+}
+
+# Fleet gauges: replica_state is a one-hot {replica=,state=} family (1
+# on the current state, 0 elsewhere — the PromQL-friendly encoding of an
+# enum); journal size is the router's open-entry count (in-flight
+# requests whose delivery record would drive a replay right now).
+FLEET_REPLICA_STATE = "tpu_fleet_replica_state"
+FLEET_JOURNAL_SIZE = "tpu_fleet_journal_inflight_requests"
+FLEET_GAUGES = {
+    FLEET_REPLICA_STATE:
+        "replica health state (fleet/health.py), one-hot over "
+        "{replica=,state=live|suspect|dead|quarantined|rejoining}",
+    FLEET_JOURNAL_SIZE:
+        "open request-journal entries (in-flight fleet requests)",
 }
 
 

@@ -13,6 +13,12 @@ calls :func:`resume_or_fresh` (the serving analogue of
 token-identically. The chaos harness drives the same helpers with a
 ``testing/faults.py`` ``Preempted`` injection instead of a real signal
 — one code path, two triggers.
+
+The same orbax step-lineage pattern also persists the fleet router's
+request journal (:func:`persist_journal` / :func:`load_journal`,
+fleet/journal.py): the snapshot is a replica's KV state for COOPERATIVE
+recovery; the journal is the router's delivery record for recovery
+after a crash that never drained.
 """
 from __future__ import annotations
 
@@ -72,16 +78,37 @@ class PreemptionGuard:
         return self._event.is_set()
 
 
-def persist_snapshot(snap: ServingSnapshot, directory: str) -> None:
-    """Write a drained snapshot under ``directory`` via the orbax
-    checkpointer (``to_pytree`` makes it StandardSave-compatible);
-    blocks until the async save lands — the process is about to exit."""
+def _persist_pytree(tree, directory: str) -> None:
+    """Write one singular pytree under ``directory`` via the orbax
+    checkpointer, advancing the step past ``latest`` (orbax's ``force=``
+    does not overwrite an existing step — StepAlreadyExists on a pod
+    lineage's second preemption) with ``max_to_keep=1`` pruning the
+    predecessor; blocks until the async save lands — the caller is
+    usually about to exit. Shared by the serving snapshot and the fleet
+    router's request journal (fleet/journal.py), which ride the same
+    preempted-pod volume."""
     from ..utils.checkpoint import TrainCheckpointer
 
     with TrainCheckpointer(directory, max_to_keep=1) as ckpt:
         latest = ckpt.latest_step()
         step = SNAPSHOT_STEP if latest is None else latest + 1
-        ckpt.save(step, snap.to_pytree(), force=True)
+        ckpt.save(step, tree, force=True)
+
+
+def _load_pytree(directory: str):
+    """Latest pytree under ``directory``, or None when there is none."""
+    from ..utils.checkpoint import TrainCheckpointer
+
+    with TrainCheckpointer(directory, max_to_keep=1) as ckpt:
+        if ckpt.latest_step() is None:
+            return None
+        return ckpt.restore()
+
+
+def persist_snapshot(snap: ServingSnapshot, directory: str) -> None:
+    """Write a drained snapshot under ``directory`` via the orbax
+    checkpointer (``to_pytree`` makes it StandardSave-compatible)."""
+    _persist_pytree(snap.to_pytree(), directory)
 
 
 def drain_to_checkpoint(engine, directory: str) -> ServingSnapshot:
@@ -98,12 +125,27 @@ def drain_to_checkpoint(engine, directory: str) -> ServingSnapshot:
 def load_snapshot(directory: str) -> Optional[ServingSnapshot]:
     """Latest persisted serving snapshot under ``directory``, or None
     when there is none (first boot)."""
-    from ..utils.checkpoint import TrainCheckpointer
+    tree = _load_pytree(directory)
+    return None if tree is None else ServingSnapshot.from_pytree(tree)
 
-    with TrainCheckpointer(directory, max_to_keep=1) as ckpt:
-        if ckpt.latest_step() is None:
-            return None
-        return ServingSnapshot.from_pytree(ckpt.restore())
+
+def persist_journal(journal, directory: str) -> None:
+    """Persist a fleet request journal (fleet/journal.py
+    ``RequestJournal``) — same pattern, different truth: the snapshot
+    carries a replica's KV state for COOPERATIVE recovery, the journal
+    carries the router's delivery record for recovery after a crash
+    that never drained. Keep the two in distinct directories (each is
+    its own orbax step lineage)."""
+    _persist_pytree(journal.to_pytree(), directory)
+
+
+def load_journal(directory: str):
+    """Latest persisted request journal under ``directory``, or None
+    when there is none (fresh router)."""
+    from ..fleet.journal import RequestJournal
+
+    tree = _load_pytree(directory)
+    return None if tree is None else RequestJournal.from_pytree(tree)
 
 
 def resume_or_fresh(make_engine: Callable[[], object],

@@ -2369,6 +2369,41 @@ class ContinuousBatcher:
         self._arrival.pop(rid, None)
         self._first_tok.pop(rid, None)
 
+    def emitted(self, req_id: int) -> list:
+        """Tokens emitted so far for an IN-FLIGHT request (eos-truncated,
+        a copy) — the fleet router's journal reads this after every step
+        to record delivered-token progress, so a hard replica crash loses
+        at most the tokens of the step it died in. Unknown/finished ids
+        return [] (finished streams are popped by ``step()``)."""
+        self._flush()                       # no-op between steps
+        out = self._out.get(req_id)
+        return self._truncate_eos(list(out)) if out else []
+
+    def cancel(self, req_id: int, reason: str = "cancelled") -> bool:
+        """Retire one request NOW — queued or active — with a surfaced
+        error record (``self.errors``), its page reservation returned to
+        the pool: the router's per-request deadline enforcement
+        (``submit(deadline_s=)``) and failover cleanup path. Same
+        contract as the poison-request isolation ``_fail_request``
+        provides mid-step, callable between steps. Returns False for
+        ids this engine does not hold."""
+        self._flush()                       # deferred reads may name it
+        for i, (rid, _prompt) in enumerate(self._queue):
+            if rid == req_id:               # never admitted: no pages yet
+                del self._queue[i]
+                self.errors[req_id] = f"Cancelled: {reason}"
+                self._request_errors += 1
+                for d in (self._budget, self._out, self._arrival,
+                          self._eos_scanned, self._first_tok):
+                    d.pop(req_id, None)
+                return True
+        for slot, rid in self._slot_req.items():
+            if rid == req_id:
+                self._fail_request(slot, req_id, RuntimeError(reason))
+                self.errors[req_id] = f"Cancelled: {reason}"
+                return True
+        return False
+
     # -- lifecycle: drain / snapshot / restore -----------------------------
     def fingerprint(self) -> Dict[str, object]:
         """The engine-compat contract a snapshot carries: everything that

@@ -20,8 +20,10 @@ makes failure a first-class, replayable input:
   ``drop`` (raise: dropped connection / failed RPC), ``delay``
   (sleep: rpc-delay / slow-dispatch), ``preempt`` (raise
   :class:`Preempted`: the mid-stream preemption signal the drain/
-  restore loop catches), ``page_pressure`` (returned to the caller —
-  the batcher holds that many pool pages hostage).
+  restore loop catches), ``crash`` (raise :class:`ReplicaCrashed`: the
+  HARD kill — the fleet router discards the engine with no drain),
+  ``page_pressure`` (returned to the caller — the batcher holds that
+  many pool pages hostage).
 - **Determinism**: matching depends only on (rule, per-site call
   index) and, for probabilistic rules, a ``random.Random`` seeded from
   (injector seed, site, rule index) — so the same seed and the same
@@ -43,7 +45,7 @@ import zlib
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple, Type
 
-_KINDS = ("drop", "delay", "preempt", "page_pressure")
+_KINDS = ("drop", "delay", "preempt", "page_pressure", "crash")
 
 
 class InjectedFault(Exception):
@@ -55,6 +57,17 @@ class Preempted(InjectedFault):
     """The preemption signal: raised out of the batcher step loop so the
     driver can drain/snapshot/restore — the in-process stand-in for the
     SIGTERM a GKE spot preemption delivers."""
+
+
+class ReplicaCrashed(InjectedFault):
+    """The HARD-kill signal (kind="crash"): unlike :class:`Preempted`,
+    nothing cooperative follows — the fleet router discards the engine
+    object outright (no drain, no snapshot; OOM / wedged device / killed
+    pod semantics) and recovery is the router-side journal replay, never
+    the dead replica's own state. Fired from the fleet hook points
+    (``fleet.step`` per router step, ``replica.crash`` once per live
+    replica per step — the per-site call index picks WHICH replica dies,
+    deterministically, since the router visits replicas in id order)."""
 
 
 @dataclass(frozen=True)
@@ -158,6 +171,8 @@ class FaultInjector:
                 self._sleep(rule.delay_s)
             elif rule.kind == "preempt":
                 raise Preempted(f"injected preemption at {site}#{index}")
+            elif rule.kind == "crash":
+                raise ReplicaCrashed(f"injected crash at {site}#{index}")
             elif rule.kind == "drop":
                 exc = rule.exc or drop_exc
                 raise exc(f"injected {site}#{index} drop")

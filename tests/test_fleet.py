@@ -16,10 +16,19 @@ Proof obligations of the fleet PR:
   deterministic round-robin (worse placement, never a crash).
 - **Lifecycle** — SIGTERM/``Preempted`` → drain → orbax persist →
   ``resume_or_fresh`` resumes token-identically (models/lifecycle.py).
+- **Crash tolerance** (the non-cooperative failure matrix) — a HARD
+  replica kill (engine discarded, no drain) at any point — during
+  prefill, mid-decode, right after a shed (source or target), twice in
+  a row — loses zero requests: the router's journal replays them onto
+  survivors and every stream stays byte-identical to the no-fault
+  reference; flapping replicas quarantine on a growing backoff and
+  rejoin serving; deadlines expire with surfaced errors; the journal
+  round-trips orbax and a restarted router resumes from it.
 """
 import dataclasses
 import os
 import signal
+import time
 
 import jax
 import jax.numpy as jnp
@@ -27,11 +36,15 @@ import numpy as np
 import pytest
 
 from k8s_gpu_scheduler_tpu.fleet import (
-    FleetError, MemoryStore, ReplicaSummary, Router, list_summaries,
-    prefix_match_len, publish_summary, summarize,
+    DEAD, FleetError, HealthMonitor, HealthPolicy, JournalError, LIVE,
+    MemoryStore, QUARANTINED, REJOINING, ReplicaSummary, RequestJournal,
+    Router, SUSPECT, list_summaries, prefix_match_len, publish_summary,
+    summarize,
 )
 from k8s_gpu_scheduler_tpu.metrics.exporter import (
-    FLEET_MIGRATED_TOTAL, FLEET_ROUTED_TOTAL, FLEET_SHED_TOTAL, Registry,
+    FLEET_EXPIRED_TOTAL, FLEET_FAILOVERS_TOTAL, FLEET_JOURNAL_SIZE,
+    FLEET_LOST_TOTAL, FLEET_MIGRATED_TOTAL, FLEET_REPLAYED_TOKENS_TOTAL,
+    FLEET_REPLICA_STATE, FLEET_ROUTED_TOTAL, FLEET_SHED_TOTAL, Registry,
 )
 from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
 from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
@@ -40,8 +53,9 @@ from k8s_gpu_scheduler_tpu.models.snapshot import (
 )
 from k8s_gpu_scheduler_tpu.obs import VirtualClock
 from k8s_gpu_scheduler_tpu.testing.faults import (
-    FaultInjector, FaultProxy, FaultRule, Preempted,
+    FaultInjector, FaultProxy, FaultRule, Preempted, ReplicaCrashed,
 )
+from k8s_gpu_scheduler_tpu.utils.retry import RetryPolicy
 
 PAGE = 8
 
@@ -639,3 +653,565 @@ class TestServeLifecycle:
         while fresh.pending:
             done.update(fresh.step())
         assert all(len(done[i]) == 3 for i in ids)
+
+
+# -- crash tolerance: health states, journal, deterministic replay --------
+# Rejoin-friendly hold (rejoin paths wait it out in a step loop) vs a
+# hold long enough that a rejoin can never interleave with a test's
+# multi-kill choreography (the serving order must stay put while a
+# second kill is armed).
+FAST_QUARANTINE = RetryPolicy(attempts=8, base_s=0.02, multiplier=2.0,
+                              max_s=0.1, jitter=0.5)
+SLOW_QUARANTINE = RetryPolicy(attempts=8, base_s=60.0, multiplier=2.0,
+                              max_s=60.0, jitter=0.0)
+
+
+def mk_fleet(params, cfg, n=3, quarantine=FAST_QUARANTINE, **router_kw):
+    """A crash-tolerant fleet: fresh-engine factory for rejoin and a
+    test-speed quarantine ladder."""
+    def factory(rid):
+        return mk_engine(params, cfg)
+
+    kw = dict(engine_factory=factory,
+              health=HealthPolicy(quarantine=quarantine))
+    kw.update(router_kw)
+    return Router([(f"r{i}", mk_engine(params, cfg)) for i in range(n)],
+                  **kw)
+
+
+def kill_next(router, inj, rid):
+    """Arm a hard kill of replica ``rid`` at the NEXT router step: the
+    ``replica.crash`` site fires once per serving replica per step in id
+    order, so the target's position in the serving list gives the
+    deterministic call index."""
+    order = [r for r in router._replicas if router.health.serving(r)]
+    offset = order.index(rid) + 1
+    inj.rules.append(FaultRule(site="replica.crash", kind="crash",
+                               at=(inj.count("replica.crash") + offset,)))
+
+
+class TestHealthMonitor:
+    def test_error_ladder_and_redemption(self):
+        hm = HealthMonitor(HealthPolicy(suspect_after=1, dead_after=3))
+        hm.add("r0")
+        boom = RuntimeError("x")
+        assert hm.note_error("r0", boom, 1.0) == (LIVE, SUSPECT)
+        assert hm.note_error("r0", boom, 2.0) is None      # still suspect
+        assert hm.note_ok("r0", 3.0) == (SUSPECT, LIVE)    # redeemed
+        for t in (4.0, 5.0):
+            hm.note_error("r0", boom, t)
+        assert hm.note_error("r0", boom, 6.0) == (SUSPECT, DEAD)
+
+    def test_declare_dead_is_terminal_evidence(self):
+        hm = HealthMonitor()
+        hm.add("r0")
+        assert hm.declare_dead("r0", "crash", 1.0) == (LIVE, DEAD)
+        assert not hm.serving("r0") and not hm.routable("r0")
+
+    def test_heartbeat_staleness_suspect_then_dead(self):
+        hm = HealthMonitor(HealthPolicy(stale_s=5.0, dead_s=15.0))
+        hm.add("r0")
+        assert hm.observe("r0", 1.0, heartbeat_age_s=4.0) is None
+        assert hm.observe("r0", 2.0, heartbeat_age_s=6.0) == \
+            (LIVE, SUSPECT)
+        assert hm.observe("r0", 3.0, heartbeat_age_s=16.0) == \
+            (SUSPECT, DEAD)
+
+    def test_watchdog_kills_wedged_engine(self):
+        hm = HealthMonitor(HealthPolicy(watchdog_s=30.0))
+        hm.add("r0")
+        assert hm.observe("r0", 1.0, last_step_age_s=10.0) is None
+        assert hm.observe("r0", 2.0, last_step_age_s=31.0) == (LIVE, DEAD)
+
+    def test_policy_validates_threshold_order(self):
+        with pytest.raises(ValueError, match="dead_s"):
+            HealthPolicy(stale_s=5.0, dead_s=5.0)
+        with pytest.raises(ValueError, match="dead_after"):
+            HealthPolicy(suspect_after=3, dead_after=2)
+
+    def test_quarantine_backoff_grows_and_breaker_latches(self):
+        pol = HealthPolicy(quarantine=RetryPolicy(
+            attempts=3, base_s=1.0, multiplier=2.0, max_s=100.0,
+            jitter=0.0))
+        hm = HealthMonitor(pol)
+        hm.add("r0")
+        hm.declare_dead("r0", "crash", 0.0)
+        hm.quarantine("r0", 0.0)
+        first_hold = hm.get("r0").quarantined_until
+        assert first_hold == pytest.approx(1.0)
+        assert not hm.due_for_rejoin("r0", 0.5)
+        assert hm.due_for_rejoin("r0", 1.5)
+        hm.start_rejoin("r0", 1.5)
+        hm.rejoined("r0", 1.6)
+        # Second death: longer hold (deaths are never reset — flap
+        # memory is the point of the breaker).
+        hm.declare_dead("r0", "crash again", 2.0)
+        hm.quarantine("r0", 2.0)
+        assert hm.get("r0").quarantined_until == pytest.approx(4.0)
+        # Third death: the attempts bound latches the breaker open.
+        hm.start_rejoin("r0", 7.0)
+        hm.rejoined("r0", 7.1)
+        hm.declare_dead("r0", "crash 3", 8.0)
+        hm.quarantine("r0", 8.0)
+        assert hm.get("r0").quarantined_until == float("inf")
+        assert not hm.due_for_rejoin("r0", 1e12)
+
+    def test_jitter_is_seeded_deterministic(self):
+        def holds(seed):
+            hm = HealthMonitor(HealthPolicy(quarantine=RetryPolicy(
+                attempts=8, base_s=1.0, jitter=0.5)), seed=seed)
+            hm.add("r0")
+            hm.declare_dead("r0", "x", 0.0)
+            hm.quarantine("r0", 0.0)
+            return hm.get("r0").quarantined_until
+
+        assert holds(7) == holds(7)
+        assert holds(7) != holds(8)
+
+
+class TestJournal:
+    def test_open_deliver_close_stream(self):
+        j = RequestJournal()
+        a = j.open([1, 2, 3], 8, trace_id="t", replica="r0",
+                   deadline_wall=123.0, submitted_wall=100.0)
+        b = j.open([4], 2, replica="r1")
+        assert (a, b) == (0, 1)
+        j.deliver(a, [10, 11])
+        j.deliver(a, [12])
+        assert j.stream(a) == [10, 11, 12]
+        assert j.entry(a).remaining == 5
+        assert j.delivered_tokens_total == 3
+        assert len(j) == 2 and a in j
+        e = j.close(a, "done")
+        assert e.trace_id == "t" and a not in j
+        assert j.closed["done"] == 1
+        with pytest.raises(JournalError):
+            j.entry(a)
+        with pytest.raises(JournalError):
+            j.close(b, "bogus-outcome")
+
+    def test_deliver_over_budget_raises(self):
+        j = RequestJournal()
+        f = j.open([1], 2)
+        with pytest.raises(JournalError, match="budget"):
+            j.deliver(f, [5, 6, 7])
+
+    def test_inflight_on_and_reassign(self):
+        j = RequestJournal()
+        a = j.open([1], 4, replica="r0")
+        b = j.open([2], 4, replica="r0")
+        j.open([3], 4, replica="r1")
+        assert [e.frid for e in j.inflight_on("r0")] == [a, b]
+        j.reassign(a, None, failover=True)
+        assert [e.frid for e in j.inflight_on(None)] == [a]
+        assert j.entry(a).failovers == 1
+
+    def test_pytree_codec_round_trip(self):
+        j = RequestJournal()
+        a = j.open([1, 2], 8, trace_id="x", replica="r2",
+                   deadline_wall=9.5, submitted_wall=1.5)
+        j.deliver(a, [7, 8, 9])
+        done = j.open([3], 1)
+        j.close(done, "done")
+        back = RequestJournal.from_pytree(j.to_pytree())
+        assert back.open_frids() == [a]
+        assert back.entry(a) == j.entry(a)
+        assert back.delivered_tokens_total == 3
+        assert back.closed["done"] == 1
+        # id namespace continues (unique across restart)
+        assert back.open([5], 1) == 2
+        with pytest.raises(JournalError):
+            RequestJournal.from_pytree({"nope": np.zeros(3)})
+
+    def test_journal_orbax_round_trip(self, tmp_path):
+        pytest.importorskip("orbax.checkpoint")
+        from k8s_gpu_scheduler_tpu.models.lifecycle import (
+            load_journal, persist_journal,
+        )
+        j = RequestJournal()
+        a = j.open([1, 2, 3], 6, trace_id="conv-1", replica="r0")
+        j.deliver(a, [42, 43])
+        d = str(tmp_path / "journal")
+        assert load_journal(d) is None
+        persist_journal(j, d)
+        persist_journal(j, d)        # second persist: step must advance
+        back = load_journal(d)
+        assert back.entry(a) == j.entry(a)
+        assert back.delivered_tokens_total == 2
+
+
+class TestEngineCancelAndEmitted:
+    def test_emitted_tracks_inflight_progress(self, setup):
+        cfg, params = setup
+        eng = mk_engine(params, cfg)
+        rid = eng.submit([1, 2, 3, 4], max_new=16)
+        assert eng.emitted(rid) == []
+        eng.step()
+        first = eng.emitted(rid)
+        assert len(first) >= 1
+        eng.step()
+        second = eng.emitted(rid)
+        assert len(second) > len(first)
+        assert second[:len(first)] == first              # append-only
+        assert eng.emitted(999) == []
+        done = {}
+        while eng.pending:
+            done.update(eng.step())
+        assert eng.emitted(rid) == []                    # popped at finish
+        assert done[rid][:len(second)] == second
+
+    def test_cancel_queued_and_active(self, setup):
+        cfg, params = setup
+        eng = mk_engine(params, cfg, n_slots=2)
+        rng = np.random.default_rng(0)
+        ids = [eng.submit(list(rng.integers(0, cfg.vocab, 6)), max_new=8)
+               for _ in range(4)]
+        eng.step()                       # 2 admitted, 2 queued
+        active = sorted(eng._slot_req.values())
+        queued = [r for r in ids if r not in active]
+        assert eng.cancel(queued[0], reason="deadline") is True
+        assert eng.cancel(active[0], reason="deadline") is True
+        assert eng.cancel(12345) is False
+        assert "deadline" in eng.errors[queued[0]]
+        assert "deadline" in eng.errors[active[0]]
+        eng._alloc.assert_consistent()
+        done = {}
+        while eng.pending:
+            done.update(eng.step())
+        # the untouched requests still finish, full-length
+        assert all(r in done or r in eng.errors for r in ids)
+        assert all(len(done[r]) == 8 for r in done)
+
+
+class TestCrashFailover:
+    def drive(self, router, prompts, max_new=10, deadlines=None):
+        frids = [router.submit(p, max_new=max_new,
+                               deadline_s=(deadlines[i] if deadlines
+                                           else None))
+                 for i, p in enumerate(prompts)]
+        done = router.run()
+        return frids, done
+
+    def test_crash_during_prefill_replays_queued_requests(self, setup):
+        """Kill the first replica on its very first step: its requests
+        have zero delivered tokens (prefill/queue), so replay is a
+        plain resubmission — zero loss, byte identity."""
+        cfg, params = setup
+        prompts, _ = mk_workload(cfg, n=9, seed=3)
+        ref = reference(params, cfg, prompts, max_new=10)
+        inj = FaultInjector(seed=0, rules=[
+            FaultRule(site="replica.crash", kind="crash", at=(1,))])
+        router = mk_fleet(params, cfg, faults=inj)
+        frids, done = self.drive(router, prompts)
+        assert [done[f] for f in frids] == ref
+        st = router.stats()
+        assert st["failovers"] == 1 and st["requests_lost"] == 0
+        assert st["replayed_tokens"] == 0          # nothing delivered yet
+
+    def test_crash_mid_decode_verifies_and_streams_suffix(self, setup):
+        """Kill a replica mid-decode: replay re-decodes only the verify
+        window (bounded rework) and the final stream is
+        byte-identical."""
+        cfg, params = setup
+        prompts, _ = mk_workload(cfg, n=9, seed=4)
+        ref = reference(params, cfg, prompts, max_new=12)
+        inj = FaultInjector(seed=0)
+        router = mk_fleet(params, cfg, faults=inj)
+        frids = [router.submit(p, max_new=12) for p in prompts]
+        done = dict(router.step())       # progress: tokens delivered
+        victim = next(f for f in frids if f in router.journal
+                      and router.journal.entry(f).delivered)
+        kill_next(router, inj, router.locate(victim)[0])
+        done.update(router.step())
+        done.update(router.run())
+        assert [done[f] for f in frids] == ref
+        st = router.stats()
+        assert st["failovers"] == 1 and st["requests_lost"] == 0
+        assert 0 < st["replayed_tokens"] <= st["journal_delivered_tokens"]
+
+    @pytest.mark.slow
+    def test_double_failure_two_replicas_die(self, setup):
+        """A replayed request's new home dies too: the journal carries
+        it through BOTH failovers. The long quarantine keeps the dead
+        replicas out so the second kill lands where the replays live."""
+        cfg, params = setup
+        prompts, _ = mk_workload(cfg, n=9, seed=5)
+        ref = reference(params, cfg, prompts, max_new=10)
+        inj = FaultInjector(seed=0)
+        router = mk_fleet(params, cfg, faults=inj,
+                          quarantine=SLOW_QUARANTINE)
+        frids = [router.submit(p, max_new=10) for p in prompts]
+        done = dict(router.step())
+        kill_next(router, inj, router.locate(frids[0])[0])
+        done.update(router.step())       # first death → replay
+        assert frids[0] in router.journal
+        kill_next(router, inj, router.locate(frids[0])[0])
+        done.update(router.step())       # second death → replay again
+        done.update(router.run())
+        assert [done[f] for f in frids] == ref
+        st = router.stats()
+        assert st["failovers"] == 2 and st["requests_lost"] == 0
+        assert router.journal.closed["done"] == len(frids)
+
+    def test_crash_after_shed_source_and_target(self, setup):
+        """The mid-shed cells of the failure matrix: migrate slots,
+        then kill the source (its remaining requests fail over) and
+        then the target (the migrated requests fail over — the
+        journal's replica pointer moved with the shed)."""
+        cfg, params = setup
+        prompts, _ = mk_workload(cfg, n=10, seed=6)
+        ref = reference(params, cfg, prompts, max_new=12)
+        inj = FaultInjector(seed=0)
+        router = mk_fleet(params, cfg, faults=inj,
+                          quarantine=SLOW_QUARANTINE)
+        frids = [router.submit(p, max_new=12) for p in prompts]
+        done = dict(router.step())
+        # all requests landed on one replica (same summaries, same
+        # placement); shed half its slots to a cold peer
+        src = router.locate(frids[0])[0]
+        dst = next(r for r in router._replicas if r != src)
+        moved = router.shed(src, dst)
+        assert moved > 0
+        migrated = [f for f in frids if router.locate(f)[0] == dst]
+        assert migrated
+        kill_next(router, inj, src)      # crash the shed SOURCE
+        done.update(router.step())
+        kill_next(router, inj, dst)      # then the shed TARGET
+        done.update(router.step())
+        done.update(router.run())
+        assert [done[f] for f in frids] == ref
+        st = router.stats()
+        assert st["failovers"] == 2 and st["requests_lost"] == 0
+
+    def test_quarantined_replica_rejoins_and_serves_again(self, setup):
+        cfg, params = setup
+        prompts, _ = mk_workload(cfg, n=6, seed=7)
+        inj = FaultInjector(seed=0)
+        router = mk_fleet(params, cfg, faults=inj)
+        frids = [router.submit(p, max_new=8) for p in prompts]
+        victim = router.locate(frids[0])[0]
+        kill_next(router, inj, victim)
+        done = dict(router.step())
+        done.update(router.run())
+        assert len(done) == len(frids)
+        # step (possibly idle) until the quarantine expires and the
+        # factory rebuilds the replica: everything live again...
+        t0 = time.monotonic()
+        while router.health.state(victim) != LIVE \
+                and time.monotonic() - t0 < 10.0:
+            done.update(router.step())
+        assert router.stats()["health_states"][LIVE] == 3
+        # ...and the rejoined replica takes new traffic.
+        prompts2, _ = mk_workload(cfg, n=6, seed=8)
+        ref2 = reference(params, cfg, prompts2, max_new=8)
+        frids2, done2 = self.drive(router, prompts2, max_new=8)
+        assert [done2[f] for f in frids2] == ref2
+
+    def test_flapping_replica_latches_breaker_open(self, setup):
+        """A replica that dies again after rejoining must end
+        PERMANENTLY quarantined, not churn the fleet."""
+        cfg, params = setup
+        prompts, _ = mk_workload(cfg, n=6, seed=9)
+        ref = reference(params, cfg, prompts, max_new=8)
+        inj = FaultInjector(seed=0)
+        router = mk_fleet(
+            params, cfg, faults=inj,
+            quarantine=RetryPolicy(attempts=2, base_s=0.02,
+                                   multiplier=2.0, max_s=0.05,
+                                   jitter=0.0))
+        frids = [router.submit(p, max_new=8) for p in prompts]
+        victim = router.locate(frids[0])[0]
+        kill_next(router, inj, victim)    # first death
+        done = dict(router.step())
+        # wait out the quarantine, let it rejoin, then kill it again
+        t0 = time.monotonic()
+        while router.health.state(victim) != LIVE \
+                and time.monotonic() - t0 < 10.0:
+            done.update(router.step())
+        assert router.health.state(victim) == LIVE
+        kill_next(router, inj, victim)    # second death → breaker open
+        done.update(router.step())
+        done.update(router.run())
+        assert [done[f] for f in frids] == ref
+        assert router.health.state(victim) == QUARANTINED
+        assert router.health.get(victim).quarantined_until == float("inf")
+        assert router.stats()["requests_lost"] == 0
+
+    def test_all_dead_no_factory_watchdog_raises(self, setup):
+        cfg, params = setup
+        prompts, _ = mk_workload(cfg, n=4, seed=10)
+        inj = FaultInjector(seed=0, rules=[
+            FaultRule(site="replica.crash", kind="crash", at=(1, 2))])
+        router = Router(
+            [(f"r{i}", mk_engine(params, cfg)) for i in range(2)],
+            faults=inj, health=HealthPolicy(quarantine=FAST_QUARANTINE))
+        frids = [router.submit(p, max_new=6) for p in prompts]
+        with pytest.raises(FleetError, match="no progress"):
+            router.run(no_progress_s=0.3)
+        # Nothing lost: the journal still holds every request, orphaned.
+        st = router.stats()
+        assert st["requests_lost"] == 0
+        assert st["journal_inflight"] == len(frids)
+
+    def test_replay_divergence_is_surfaced_not_streamed(self, setup):
+        """Tamper a journaled delivery, then kill its replica: the
+        replayed stream cannot match the forged journal, and the
+        request must FAIL LOUDLY (Router.errors) rather than stream a
+        spliced answer."""
+        cfg, params = setup
+        prompts, _ = mk_workload(cfg, n=6, seed=11)
+        inj = FaultInjector(seed=0)
+        router = mk_fleet(params, cfg, faults=inj)
+        frids = [router.submit(p, max_new=12) for p in prompts]
+        done = dict(router.step())
+        victims = [f for f in frids
+                   if f in router.journal
+                   and len(router.journal.entry(f).delivered) >= 2]
+        assert victims, "need an in-flight request with progress"
+        victim = victims[0]
+        router.journal.entry(victim).delivered[-1] ^= 1   # forge
+        kill_next(router, inj, router.locate(victim)[0])
+        done.update(router.step())
+        done.update(router.run())
+        assert victim in router.errors
+        assert "divergence" in router.errors[victim]
+        assert victim not in done
+        # every OTHER request is intact
+        for f in frids:
+            if f != victim:
+                assert f in done
+
+    def test_deadline_expiry_queued_and_active(self, setup):
+        """submit(deadline_s=): expired requests fail with a surfaced
+        error record, pages retired, journal entry closed — never
+        silently stuck."""
+        cfg, params = setup
+        clock = VirtualClock()
+        router = Router([("r0", mk_engine(params, cfg, n_slots=2))],
+                        clock=clock)
+        rng = np.random.default_rng(0)
+        prompts = [list(rng.integers(0, cfg.vocab, 6)) for _ in range(4)]
+        # 2 admit, 2 queue behind them (n_slots=2)
+        frids = [router.submit(p, max_new=32, deadline_s=5.0)
+                 for p in prompts]
+        ok = router.submit(prompts[0], max_new=4)       # no deadline
+        router.step()
+        clock.advance(10.0)                             # all 4 expire
+        done = router.step()
+        for f in frids:
+            assert "deadline exceeded" in router.errors[f]
+            assert f not in router.journal
+        eng = router._replicas["r0"].engine
+        eng._alloc.assert_consistent()                  # pages retired
+        assert len(eng.errors) == 4                     # engine mirror
+        done.update(router.run())
+        assert len(done[ok]) == 4                       # survivor fine
+        assert router.stats()["deadline_expired"] == 4
+
+    def test_journal_survives_router_restart(self, setup, tmp_path):
+        """Persist the journal mid-flight, throw the router away, boot
+        a new one over FRESH engines from the same journal_dir: every
+        open request replays and completes byte-identically."""
+        pytest.importorskip("orbax.checkpoint")
+        cfg, params = setup
+        prompts, _ = mk_workload(cfg, n=6, seed=12)
+        ref = reference(params, cfg, prompts, max_new=10)
+        jdir = str(tmp_path / "journal")
+        r1 = mk_fleet(params, cfg, journal_dir=jdir)
+        frids = [r1.submit(p, max_new=10) for p in prompts]
+        for _ in range(3):
+            r1.step()
+        assert len(r1.journal) > 0
+        r1.checkpoint_journal()
+        delivered_before = {f: r1.journal.stream(f)
+                            for f in r1.journal.open_frids()}
+        # r1's process "dies" here (no drain); new router, new engines.
+        r2 = mk_fleet(params, cfg, journal_dir=jdir)
+        done = r2.run()
+        for f in frids:
+            if f in done:
+                assert done[f] == ref[f]
+                assert done[f][:len(delivered_before.get(f, []))] == \
+                    delivered_before.get(f, [])
+        # every entry that was open at checkpoint time completed
+        assert set(done) == set(delivered_before)
+        assert r2.stats()["requests_lost"] == 0
+
+    def test_step_isolates_one_replicas_exception(self, setup):
+        """The PR's bugfix satellite: one replica raising inside
+        Router.step() no longer unwinds the peers' step — it walks the
+        suspect→dead ladder while everyone else makes progress."""
+        cfg, params = setup
+        prompts, _ = mk_workload(cfg, n=8, seed=13)
+        ref = reference(params, cfg, prompts, max_new=8)
+        bad_inj = FaultInjector(seed=0, rules=[
+            FaultRule(site="serve.step", kind="drop", every=1)])
+        engines = [("r0", mk_engine(params, cfg, fault_injector=bad_inj)),
+                   ("r1", mk_engine(params, cfg)),
+                   ("r2", mk_engine(params, cfg))]
+        router = Router(engines,
+                        health=HealthPolicy(quarantine=FAST_QUARANTINE))
+        frids = [router.submit(p, max_new=8) for p in prompts]
+        done = router.run()
+        assert [done[f] for f in frids] == ref
+        st = router.stats()
+        # r0 errored its way down the ladder and its requests replayed
+        assert st["health_states"][QUARANTINED] == 1
+        assert st["requests_lost"] == 0
+        assert router.health.get("r0").consecutive_errors == 0
+
+    def test_fleet_metrics_catalog(self, setup):
+        cfg, params = setup
+        prompts, _ = mk_workload(cfg, n=8, seed=14)
+        reg = Registry()
+        inj = FaultInjector(seed=0)
+        router = mk_fleet(params, cfg, faults=inj, metrics=reg)
+        frids = [router.submit(p, max_new=10) for p in prompts]
+        done = dict(router.step())
+        victim = router.locate(frids[0])[0]
+        kill_next(router, inj, victim)
+        done.update(router.step())
+        done.update(router.run())
+        assert reg.counter(FLEET_FAILOVERS_TOTAL).value(
+            replica=victim) == 1
+        assert reg.counter(FLEET_LOST_TOTAL).value() == 0
+        assert reg.counter(FLEET_REPLAYED_TOKENS_TOTAL).value() > 0
+        assert reg.counter(FLEET_EXPIRED_TOTAL).value() == 0
+        # step until the victim rejoins, then the state gauge must be
+        # one-hot live for every replica
+        t0 = time.monotonic()
+        while router.health.state(victim) != LIVE \
+                and time.monotonic() - t0 < 10.0:
+            router.step()
+        g = reg.gauge(FLEET_REPLICA_STATE)
+        for rid in ("r0", "r1", "r2"):
+            assert g.value(replica=rid, state=LIVE) == 1.0
+            assert sum(g.value(replica=rid, state=s)
+                       for s in ("live", "suspect", "dead",
+                                 "quarantined", "rejoining")) == 1.0
+        assert reg.gauge(FLEET_JOURNAL_SIZE).value() == 0.0
+        exposition = reg.expose()
+        assert "tpu_fleet_replica_state" in exposition
+        assert "tpu_fleet_requests_lost_total" in exposition
+        assert "tpu_fleet_journal_inflight_requests" in exposition
+
+    def test_tracer_records_failover_events(self, setup):
+        from k8s_gpu_scheduler_tpu.obs import Tracer
+        cfg, params = setup
+        prompts, _ = mk_workload(cfg, n=6, seed=15)
+        tracer = Tracer()
+        inj = FaultInjector(seed=0)
+        router = mk_fleet(params, cfg, faults=inj, tracer=tracer)
+        frids = [router.submit(p, max_new=8) for p in prompts]
+        done = dict(router.step())
+        kill_next(router, inj, router.locate(frids[0])[0])
+        done.update(router.step())
+        done.update(router.run())
+        names = [s.name for s in tracer.spans()]
+        assert "replica_dead" in names
+        assert "failover" in names
+        assert "replay" in names
+        # the target engine's flight recorder logged the replay too
+        assert any(rep.engine is not None
+                   and rep.engine._flight.records("replay")
+                   for rep in router._replicas.values())

@@ -291,6 +291,29 @@ class TestServingPoolExport:
         assert ('tpu_fleet_routed_requests_total'
                 '{policy="degraded",replica="r1"} 2.0') in text
 
+    def test_fleet_gauges_catalogued_one_hot_state(self):
+        """The crash-tolerance gauges: replica_state is a one-hot
+        {replica=,state=} family, the journal gauge a plain level."""
+        from k8s_gpu_scheduler_tpu.metrics.exporter import (
+            FLEET_GAUGES, FLEET_JOURNAL_SIZE, FLEET_REPLICA_STATE,
+        )
+
+        reg = Registry()
+        g = reg.gauge(FLEET_REPLICA_STATE,
+                      FLEET_GAUGES[FLEET_REPLICA_STATE])
+        for state, v in (("live", 0.0), ("quarantined", 1.0)):
+            g.set(v, replica="r0", state=state)
+        reg.gauge(FLEET_JOURNAL_SIZE,
+                  FLEET_GAUGES[FLEET_JOURNAL_SIZE]).set(3)
+        text = reg.expose()
+        for name in FLEET_GAUGES:
+            assert f"# HELP {name}" in text
+        assert ('tpu_fleet_replica_state'
+                '{replica="r0",state="quarantined"} 1.0') in text
+        assert ('tpu_fleet_replica_state'
+                '{replica="r0",state="live"} 0.0') in text
+        assert "tpu_fleet_journal_inflight_requests 3.0" in text
+
     def test_absent_keys_are_skipped(self):
         """Contiguous layout ({}) and prefix-cache-off snapshots publish
         what they have — unconditional per-step publishing is safe."""
