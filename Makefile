@@ -15,11 +15,14 @@ native:
 
 # graftcheck fast passes (AST lint incl. retry-lint + trace-lint
 # [trace-in-jit], Pallas VMEM budgeter — no tracing; the same gate tier-1
-# runs via tests/test_graftcheck_clean.py). The full seven-pass analyzer
-# (jaxpr audit + recompile/donation guard + alias audit) is
-# `$(PY) -m k8s_gpu_scheduler_tpu.analysis` with no flags.
+# runs via tests/test_graftcheck_clean.py) plus the GSPMD sharding audit
+# (--gspmd: tracing-only walk of the sharded entry points against the
+# parallel/sharding.py rules table — no compilation, seconds). The full
+# eight-pass analyzer (jaxpr audit + recompile/donation guard + alias
+# audit + gspmd) is `$(PY) -m k8s_gpu_scheduler_tpu.analysis` with no
+# flags.
 lint:
-	$(PY) -m k8s_gpu_scheduler_tpu.analysis --fast
+	$(PY) -m k8s_gpu_scheduler_tpu.analysis --fast --gspmd
 
 test: native
 	$(PY) -m pytest tests/
@@ -44,6 +47,7 @@ bench-smoke:
 	$(PY) bench.py --leg fleet --smoke
 	$(PY) bench.py --leg fleet_chaos --smoke
 	$(PY) bench.py --leg chunked_prefill --smoke
+	$(PY) bench.py --leg sharded_decode --smoke
 	$(PY) bench.py --leg decode_attention --smoke
 
 demo: native

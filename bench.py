@@ -2083,6 +2083,114 @@ def bench_chunked_prefill(smoke=False):
     }
 
 
+def bench_sharded_decode(smoke=False, tp=2):
+    """Multi-chip sharded paged serving (shard_map islands over tp) on
+    FORCED host devices: the same open-loop workload through an
+    unsharded (tp=1) and a sharded (tp=N) paged engine, CI-asserting the
+    whole contract — token identity (sharding must never change an
+    answer), zero retrace across the measured steady-state pass with the
+    pool + scales + table donated through the island, per-chip kv-pool
+    resident bytes scaling 1/tp (the capacity headroom the feature
+    exists for), and tok/s on both so the island's gather/communication
+    overhead stays visible run over run. On real multi-chip hardware the
+    same leg measures the actual scale-up; under the CPU backend the
+    tok/s DELTA is emulation noise — only the invariants are asserted."""
+    # Must land before the first jax backend init: host-platform device
+    # virtualization is how the leg gets a multi-chip mesh in CI. APPEND
+    # to a pre-set XLA_FLAGS rather than setdefault — a developer's
+    # exported flags would otherwise leave 1 device and silently degrade
+    # the leg to its error dict.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = ((flags + " ") if flags else "") + \
+            f"--xla_force_host_platform_device_count={2 * tp}"
+    import dataclasses
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from k8s_gpu_scheduler_tpu.analysis.recompile import RecompileGuard
+    from k8s_gpu_scheduler_tpu.models.llama import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+    if len(jax.devices()) < tp:
+        return {"metric": "sharded_decode_tok_s", "value": 0.0,
+                "unit": "tok/s",
+                "extra": {"sharded_error":
+                          f"need {tp} devices, have {len(jax.devices())}"}}
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny() if not on_tpu or smoke else LlamaConfig(
+            vocab=32000, d_model=1024, n_layers=4, n_heads=16,
+            n_kv_heads=8, d_ff=2816, max_seq=2048, remat=False),
+        decode_attn="fused")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    max_len, page = (64, 8) if not on_tpu or smoke else (1024, 64)
+    n_req, max_new = (10, 8) if smoke else (24, 16)
+
+    def build(mesh):
+        return ContinuousBatcher(
+            params, cfg, n_slots=4, max_len=max_len, chunk=4,
+            prefill_bucket=2 * page, kv_dtype="int8", kv_layout="paged",
+            page_size=page, mesh=mesh)
+
+    def drive(eng, measure=False):
+        rng = np.random.default_rng(7)
+        t0 = time.perf_counter()
+        out = {}
+        guard = None
+        for wave in range(3):
+            for _ in range(n_req // 3):
+                eng.submit(rng.integers(0, cfg.vocab, int(
+                    rng.integers(page // 2, 3 * page))), max_new=max_new)
+            out.update(eng.run())
+            if measure and wave == 0 and guard is None:
+                # Wave 0 is the warmup (both block-table jit keys + the
+                # lens/last committal); waves 1-2 are the measured
+                # steady state.
+                guard = RecompileGuard()
+                guard.track("decode", eng._decode)
+                guard.track("prefill", eng._prefill)
+                guard.snapshot()
+        wall = time.perf_counter() - t0
+        toks = sum(len(v) for v in out.values())
+        misses = guard.misses_since() if guard else {}
+        return out, toks / wall, misses
+
+    e1 = build(None)
+    ref, tok_s_1, _ = drive(e1)
+    bytes_1 = e1.pool_metrics()["kv_pool_device_bytes"]
+
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+    e2 = build(mesh)
+    got, tok_s_tp, misses = drive(e2, measure=True)
+    pm = e2.pool_metrics()
+    bytes_tp = pm["kv_pool_device_bytes"]
+
+    extra = {
+        "sharded_interpret": not on_tpu,
+        "sharded_tp": tp,
+        "sharded_token_identity": got == ref,
+        "sharded_zero_retrace": not any(misses.values()),
+        "sharded_retraces": {k: int(v) for k, v in misses.items()},
+        "sharded_pool_bytes_tp1": int(bytes_1),
+        "sharded_pool_bytes_per_chip": int(bytes_tp),
+        # Exact 1/tp: the pool shards on the kv-heads dim with no
+        # padding (Hkv % tp == 0 is an admission-time invariant).
+        "sharded_pool_bytes_scaled": int(bytes_tp) * tp == int(bytes_1),
+        "sharded_tok_s_tp1": round(tok_s_1, 1),
+        f"sharded_tok_s_tp{tp}": round(tok_s_tp, 1),
+    }
+    return {
+        "metric": "sharded_decode_tok_s",
+        "value": round(tok_s_tp, 1),
+        "unit": "tok/s",
+        "extra": extra,
+    }
+
+
 def main(argv=None):
     args = list(sys.argv[1:] if argv is None else argv)
     if "--leg" in args:
@@ -2123,10 +2231,14 @@ def main(argv=None):
         if leg == "chunked_prefill":
             print(json.dumps(bench_chunked_prefill(smoke="--smoke" in args)))
             return
+        if leg == "sharded_decode":
+            print(json.dumps(bench_sharded_decode(smoke="--smoke" in args)))
+            return
         raise SystemExit(f"unknown bench leg: {leg!r} (available: "
                          f"decode_attention, paged_attention, prefix_cache, "
                          f"speculative, analysis, chaos, obs_overhead, "
-                         f"fleet, fleet_chaos, chunked_prefill)")
+                         f"fleet, fleet_chaos, chunked_prefill, "
+                         f"sharded_decode)")
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
     # the bench measures the scheduler as deployed.
     sys.setswitchinterval(0.001)

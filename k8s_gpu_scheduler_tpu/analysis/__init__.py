@@ -31,6 +31,15 @@ k8s_gpu_scheduler_tpu.analysis``; importable APIs below):
    a jit-traced body are host syncs (at best trace-time constants that
    replay a lie); tracing belongs on the host side of the dispatch, and
    this pass keeps it there.
+8. **GSPMD sharding audit** (``gspmd``): walks the traced jaxpr of the
+   sharded entry points (generate-with-mesh, the paged serving
+   shard_map islands) and checks every ``sharding_constraint`` /
+   island mapping against the rules table in parallel/sharding.py —
+   rank-5 cache constraints must match ``serving.CACHE_SPEC``, island
+   pools must map the kv-heads dim to ``tp`` (POOL_SPEC), big scan
+   carries outside islands must be constrained somewhere, and nothing
+   huge may be annotated fully-replicated. Tracing-only (no
+   compilation), so ``make lint`` runs it too (``--fast --gspmd``).
 
 Suppression: ``# graftcheck: ignore[rule]`` on the offending line, with a
 rationale in the surrounding comment (policy in README).
@@ -68,6 +77,7 @@ __all__ = [
     "audit_shared_pages",
     "check_shared_pages",
     "run_fast_passes",
+    "run_gspmd_pass",
     "run_traced_passes",
 ]
 
@@ -169,6 +179,35 @@ def run_traced_passes(paths=None) -> Report:
             name, build = entry
             report.extend(audit_shared_pages(build, name))
     report.pass_seconds["alias"] = time.perf_counter() - t0
+
+    gspmd = run_gspmd_pass(paths)
+    report.findings.extend(gspmd.findings)
+    report.pass_seconds.update(gspmd.pass_seconds)
+    return report
+
+
+def run_gspmd_pass(paths=None) -> Report:
+    """GSPMD sharding-annotation audit (analysis/gspmd.py) over the
+    sharded entry points plus any ``GRAFTCHECK_GSPMD_AUDIT`` hooks found
+    in ``paths``. Tracing-only — cheap enough that ``make lint`` runs it
+    next to the fast passes (``--fast --gspmd``); also folded into the
+    full traced run."""
+    import time
+
+    from . import entrypoints as eps
+    from .gspmd import audit_sharded_callable
+
+    report = Report()
+    t0 = time.perf_counter()
+    for name, fn, args, expect in eps.gspmd_entrypoints():
+        report.extend(audit_sharded_callable(fn, args, name, **expect))
+    for src, attr, entries in _discover_hooks(
+            paths, ("GRAFTCHECK_GSPMD_AUDIT",)):
+        for entry in _safe_entries(report, src, attr, entries, arity=4):
+            name, fn, args, expect = entry
+            report.extend(audit_sharded_callable(
+                fn, args, name, **dict(expect)))
+    report.pass_seconds["gspmd"] = time.perf_counter() - t0
     return report
 
 

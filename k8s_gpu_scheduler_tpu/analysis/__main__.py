@@ -25,6 +25,10 @@ def main(argv=None) -> int:
                              "tree is always included)")
     parser.add_argument("--fast", action="store_true",
                         help="AST lint + VMEM budgeter only (no tracing)")
+    parser.add_argument("--gspmd", action="store_true",
+                        help="with --fast: add the GSPMD sharding audit "
+                             "(tracing-only, no compilation — what "
+                             "`make lint` runs); implied by the full run")
     parser.add_argument("--json", action="store_true",
                         help="emit a JSON summary line")
     parser.add_argument("--warnings-as-errors", action="store_true")
@@ -33,7 +37,7 @@ def main(argv=None) -> int:
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     paths = [pkg_root] + list(args.paths)
 
-    if not args.fast:
+    if not args.fast or args.gspmd:
         # The traced passes initialize jax: keep tier-1's hermetic-CPU
         # convention and give the pipeline entry point a multi-device mesh
         # BEFORE the first jax import.
@@ -41,13 +45,18 @@ def main(argv=None) -> int:
         os.environ.setdefault(
             "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
-    from . import run_fast_passes, run_traced_passes
+    from . import run_fast_passes, run_gspmd_pass, run_traced_passes
 
     report = run_fast_passes(paths)
     if not args.fast:
+        # The full traced run already folds the gspmd pass in.
         traced = run_traced_passes(paths)
         report.findings.extend(traced.findings)
         report.pass_seconds.update(traced.pass_seconds)
+    elif args.gspmd:
+        gspmd = run_gspmd_pass(paths)
+        report.findings.extend(gspmd.findings)
+        report.pass_seconds.update(gspmd.pass_seconds)
 
     failing = report.findings if args.warnings_as_errors else report.errors
     if args.json:

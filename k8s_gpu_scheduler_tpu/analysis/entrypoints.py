@@ -168,6 +168,84 @@ def jaxpr_entrypoints() -> List[Tuple[str, Callable, tuple]]:
     return entries
 
 
+# -- GSPMD sharding-audit entry points ----------------------------------------
+
+def _audit_mesh():
+    """The forced-host mesh the sharded entries trace under: all five
+    axis names present (CACHE_SPEC references dp/fsdp/tp), tp=2 when the
+    process has at least two devices (the CLI/conftest force 8), tp=1
+    otherwise — the annotations (what this audit reads) are identical
+    either way."""
+    import jax
+
+    from ..parallel.mesh import MeshSpec, make_mesh
+
+    tp = 2 if len(jax.devices()) >= 2 else 1
+    return make_mesh(MeshSpec.for_devices(tp, tp=tp))
+
+
+def _sharded_tiny_engine(speculative: bool = False):
+    """A multi-chip paged engine (shard_map islands over tp) at toy
+    scale — the jitted dispatches the gspmd audit traces and the
+    recompile/donation scenarios drive."""
+    import dataclasses
+
+    from ..models import serving
+
+    cfg, params = _tiny()
+    return serving.ContinuousBatcher(
+        params, dataclasses.replace(cfg, decode_attn="fused"), n_slots=2,
+        max_len=32, chunk=2, prefill_bucket=8, kv_dtype="int8",
+        kv_layout="paged", page_size=8, mesh=_audit_mesh(),
+        speculative=speculative, gamma=2 if speculative else 4)
+
+
+def gspmd_entrypoints() -> List[Tuple[str, Callable, tuple, dict]]:
+    """(name, fn, args, expectations) for the GSPMD sharding audit
+    (analysis/gspmd.py): the mesh-constrained static generate path
+    (``cache_spec=True`` — its rank-5 cache constraints must match
+    CACHE_SPEC) and the three paged serving islands (``pool_spec=True``
+    — their rank-5 pool operands must map the kv-heads dim to tp)."""
+    import jax.numpy as jnp
+
+    from ..models import serving
+
+    cfg, params = _tiny()
+    mesh = _audit_mesh()
+    prompt = jnp.zeros((2, 8), jnp.int32)
+    entries: List[Tuple[str, Callable, tuple, dict]] = [
+        ("generate_sharded",
+         partial(serving.generate, cfg=cfg, max_new=4, mesh=mesh,
+                 max_len=32),
+         (params, prompt), {"cache_spec": True}),
+    ]
+
+    eng = _sharded_tiny_engine()
+    slots = np.zeros((2,), np.int32)
+    lens = np.full((2,), 4, np.int32)
+    pids = np.ones((2, 1), np.int32)
+    tokens8 = np.zeros((2, 8), np.int32)
+    entries.append((
+        "batcher_decode_paged_tp", eng._decode,
+        (eng.params, eng._k, eng._v, eng._ks, eng._vs,
+         eng._table_np.copy(), eng._lens, eng._last,
+         np.asarray([True, False]), np.int32(2)), {"pool_spec": True}))
+    entries.append((
+        "batcher_prefill_paged_tp", eng._prefill,
+        (eng.params, eng._k, eng._v, eng._ks, eng._vs, eng._lens,
+         eng._last, slots, pids, np.zeros((2, 0), np.int32),
+         np.zeros((2,), np.int32), tokens8, lens, np.int32(1)),
+        {"pool_spec": True}))
+    seng = _sharded_tiny_engine(speculative=True)
+    entries.append((
+        "batcher_verify_paged_tp", seng._decode,
+        (seng.params, seng._k, seng._v, seng._ks, seng._vs,
+         seng._table_np.copy(), seng._lens, seng._last,
+         np.zeros((2, 2), np.int32), np.asarray([True, False])),
+        {"pool_spec": True}))
+    return entries
+
+
 # -- steady-state decode / donation scenarios ---------------------------------
 
 def _batcher_scenario() -> tuple:
@@ -398,6 +476,40 @@ def _paged_spec_batcher_scenario() -> tuple:
     return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
 
 
+def _sharded_paged_batcher_scenario() -> tuple:
+    """Multi-chip edition of the paged scenario: steady-state decode on a
+    FORCED multi-device host mesh (shard_map islands over tp, pool
+    sharded on kv heads) across waves whose block tables differ — the
+    zero-retrace + donation contract must survive the island boundary:
+    jit keys now include shardings, so this scenario is the guard the
+    ROADMAP asked to run \"under a real multi-process mesh\" in its
+    CI-reachable form (XLA host-platform device virtualization exercises
+    the same GSPMD/shard_map partitioning the TPU path uses)."""
+    eng = _sharded_tiny_engine()
+    rng = np.random.default_rng(0)
+    cfg = eng.cfg
+
+    def warmup():
+        # Two waves: covers the prefill rung, the decode program under
+        # BOTH block-table jit keys (numpy upload on admission steps,
+        # donated-through device table on pure-decode steps), and the
+        # host-built → island-output lens/last committal.
+        eng.submit(rng.integers(0, cfg.vocab, 5), max_new=3)
+        eng.run()
+        eng.submit(rng.integers(0, cfg.vocab, 6), max_new=3)
+        eng.run()
+
+    def wave(plen: int):
+        def go():
+            eng.submit(rng.integers(0, cfg.vocab, plen), max_new=3)
+            eng.submit(rng.integers(0, cfg.vocab, plen - 1), max_new=2)
+            eng.run()
+        return go
+
+    steady = [wave(4), wave(6), wave(8)]
+    return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
+
+
 def _generate_scenario() -> tuple:
     import jax
     import jax.numpy as jnp
@@ -426,6 +538,7 @@ def recompile_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
         ("batcher_steady_decode_paged_prefix", _paged_prefix_batcher_scenario),
         ("batcher_steady_decode_paged_spec", _paged_spec_batcher_scenario),
         ("batcher_steady_mixed_chunked", _paged_chunked_batcher_scenario),
+        ("batcher_steady_decode_paged_tp", _sharded_paged_batcher_scenario),
         ("generate_steady_state", _generate_scenario),
     ]
 
@@ -500,6 +613,18 @@ def donation_audit() -> List:
     findings += check_donation(peng2._prefill, *pxargs,
                                donated=(1, 2, 3, 4),
                                name="batcher_prefill_paged_prefix")
+
+    # Sharded paged decode (shard_map island over tp): the pool/scale
+    # shards and the replicated table must all be consumed through the
+    # island boundary — donation now aliases per-chip buffers, and a
+    # silent copy would double every chip's pool.
+    teng = _sharded_tiny_engine()
+    targs = (teng.params, teng._k, teng._v, teng._ks, teng._vs,
+             jnp.asarray(teng._table_np), teng._lens, teng._last,
+             np.asarray([True, True]), np.int32(1))
+    findings += check_donation(teng._decode, *targs,
+                               donated=(1, 2, 3, 4, 5),
+                               name="batcher_decode_paged_tp")
 
     opt = optax.adamw(1e-3)
     state = jax.jit(opt.init)(params)

@@ -1,0 +1,309 @@
+"""GSPMD sharding-annotation audit — graftcheck's eighth pass.
+
+Sharding bugs are the quietest perf/memory class in a GSPMD program: a
+cache constrained on the wrong dim still produces correct tokens (XLA
+inserts collectives to fix up the mismatch), a scan carry with no
+constraint silently replicates the KV cache onto every chip, and a
+shard_map island whose pool operand is mapped on the wrong axis ships
+the whole pool through ICI every dispatch. None of it fails a test; all
+of it shows up as "the 70B config OOMs" months later. This pass walks
+the traced jaxpr of every sharded entry point (tracing only — no
+compilation, so it is cheap enough for ``make lint``) and checks the
+annotations against the ONE rules table the models declare their specs
+from (parallel/sharding.py):
+
+- ``cache-spec-mismatch`` / ``cache-spec-missing``: every
+  ``sharding_constraint`` on a rank-5 operand (the KV cache/pool rank —
+  the repo convention this pass enforces) must carry exactly
+  ``serving.CACHE_SPEC``; decode entry points registered with
+  ``cache_spec=True`` must have at least one.
+- ``island-pool-spec`` / ``island-missing``: entry points registered
+  with ``pool_spec=True`` are shard_map islands over the paged pool —
+  every rank-5 island operand must be mapped on the KV-HEADS dim (axis
+  3) to the ``tp`` mesh axis and nothing else (``POOL_SPEC``); an entry
+  with no island at all is flagged too (the gate that the sharded path
+  didn't silently degrade to a replicated dispatch).
+- ``unconstrained-scan-carry``: a big (> ``CARRY_ELEMS_LIMIT``) scan
+  carry OUTSIDE any island whose shape is never sharding-constrained
+  anywhere in the program — GSPMD propagates whatever it likes through
+  the loop, usually full replication of the largest buffer in the
+  program. Island-internal scans are exempt: the island's specs already
+  pin their layout per shard.
+- ``oversized-replicated``: an explicitly replicated annotation (an
+  all-``None`` constraint, or an unmapped island operand) on a buffer
+  bigger than ``REPLICATED_BYTES_LIMIT`` — replication is the default,
+  ANNOTATING it on something huge is almost always a wrong spec.
+- ``unknown-mesh-axis``: a constraint naming a mesh axis outside the
+  rules table's vocabulary (dp/fsdp/sp/ep/tp) — a typo'd axis silently
+  replicates.
+
+Entry points come from ``entrypoints.gspmd_entrypoints()``; out-of-tree
+code (and the seeded bad fixture) opts in via a module-level
+``GRAFTCHECK_GSPMD_AUDIT = [(name, fn, args, expect), ...]`` hook, the
+same discovery protocol as the other traced hooks.
+
+Thresholds follow the repo's audit convention (see jaxpr_audit): entry
+points trace at TOY shapes, so anything that scales with the model —
+including the serving islands' deliberately replicated weight operands —
+stays far below the limits, and only a genuinely suspicious tensor
+crosses them. A hook registering REAL-model shapes must either pass
+``replicated_bytes_limit``/``carry_elems_limit`` overrides or expect the
+replicated-weights layout to be flagged (at real scale, a >1 MiB
+replicated island operand usually IS the bug this rule hunts).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding
+
+CARRY_ELEMS_LIMIT = 1 << 15        # 32k-element scan carry
+REPLICATED_BYTES_LIMIT = 1 << 20   # 1 MiB explicitly-replicated buffer
+CACHE_RANK = 5                     # [L, B|n_pages, S|ps, Hkv, hd]
+
+
+def _known_mesh_axes() -> Set[str]:
+    """The mesh-axis vocabulary every annotation must draw from — the
+    VALUES of parallel/sharding.py's rules table, read at audit time so
+    a new axis added to the table is automatically legal here."""
+    from ..parallel.sharding import DEFAULT_RULES
+
+    axes: Set[str] = set()
+    for v in DEFAULT_RULES.values():
+        if v is None:
+            continue
+        if isinstance(v, (tuple, list)):
+            axes.update(str(a) for a in v)
+        else:
+            axes.add(str(v))
+    return axes
+
+
+def _expected_pool_mapping() -> Dict[int, Tuple[str, ...]]:
+    """The shard_map in_names mapping a pool operand must carry —
+    derived from the SAME rules-table entry the serving islands derive
+    POOL_SPEC from (`spec_for(KV_POOL_AXES, DEFAULT_RULES)`), so the
+    runtime and this guard rail cannot drift: {3: ('tp',)} under the
+    default rules."""
+    from ..parallel.sharding import DEFAULT_RULES, KV_POOL_AXES, spec_for
+
+    out: Dict[int, Tuple[str, ...]] = {}
+    for i, e in enumerate(spec_for(KV_POOL_AXES, DEFAULT_RULES)):
+        if e is None:
+            continue
+        out[i] = (tuple(str(a) for a in e)
+                  if isinstance(e, (tuple, list)) else (str(e),))
+    return out
+
+
+def _norm_spec(spec, rank: int) -> Tuple[Tuple[str, ...], ...]:
+    """PartitionSpec → length-``rank`` tuple of mesh-axis tuples (() =
+    replicated dim), so trailing-None-trimmed and untrimmed specs
+    compare equal."""
+    out = []
+    n = len(spec) if spec is not None else 0
+    for i in range(rank):
+        e = spec[i] if i < n else None
+        if e is None:
+            out.append(())
+        elif isinstance(e, (tuple, list)):
+            out.append(tuple(str(a) for a in e))
+        else:
+            out.append((str(e),))
+    return tuple(out)
+
+
+def _expected_cache_spec() -> Tuple[Tuple[str, ...], ...]:
+    from ..models.serving import CACHE_SPEC
+
+    return _norm_spec(CACHE_SPEC, CACHE_RANK)
+
+
+def _spec_axes(norm) -> Set[str]:
+    return {a for dim in norm for a in dim}
+
+
+def _iter_subjaxprs(params: dict):
+    """(param_key, jaxpr) for every sub-jaxpr in an eqn's params —
+    shared shape with jaxpr_audit's walker."""
+    import jax.core as jc
+
+    for key, val in params.items():
+        vals = val if isinstance(val, (tuple, list)) else [val]
+        for v in vals:
+            if isinstance(v, jc.ClosedJaxpr):
+                yield key, v.jaxpr
+            elif isinstance(v, jc.Jaxpr):
+                yield key, v
+
+
+def audit_sharded_jaxpr(closed, name: str, cache_spec: bool = False,
+                        pool_spec: bool = False,
+                        carry_elems_limit: int = CARRY_ELEMS_LIMIT,
+                        replicated_bytes_limit: int = REPLICATED_BYTES_LIMIT,
+                        ) -> List[Finding]:
+    """Audit one ClosedJaxpr (``jax.make_jaxpr(fn)(*args)``) against the
+    GSPMD rules. ``cache_spec``/``pool_spec`` assert the entry-point
+    expectations described in the module docstring."""
+    anchor = f"<gspmd:{name}>"
+    findings: List[Finding] = []
+    expected_cache = _expected_cache_spec()
+    known_axes = _known_mesh_axes()
+    expected_pool = _expected_pool_mapping()
+
+    constrained_shapes: Set[tuple] = set()
+    cache_constraints: List[Tuple[tuple, tuple]] = []   # (shape, norm spec)
+    islands: List[Any] = []
+    scans: List[Tuple[Any, bool]] = []                  # (eqn, in_island)
+
+    def collect(jaxpr, in_island: bool) -> None:
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim == "sharding_constraint":
+                aval = eqn.invars[0].aval
+                shd = eqn.params.get("sharding")
+                spec = getattr(shd, "spec", None)
+                if spec is None:
+                    # A non-Named sharding (GSPMD/HLO-level): nothing to
+                    # compare against the rules table — surface it so it
+                    # cannot hide a wrong layout behind an opaque type.
+                    findings.append(Finding(
+                        "opaque-sharding", anchor, 0,
+                        f"{name}: sharding_constraint on "
+                        f"{tuple(aval.shape)} carries a "
+                        f"{type(shd).__name__}, not a NamedSharding — "
+                        f"the rules-table audit cannot see it",
+                        severity="warning"))
+                    continue
+                norm = _norm_spec(spec, len(aval.shape))
+                constrained_shapes.add(tuple(aval.shape))
+                bad_axes = _spec_axes(norm) - known_axes
+                if bad_axes:
+                    findings.append(Finding(
+                        "unknown-mesh-axis", anchor, 0,
+                        f"{name}: constraint on {tuple(aval.shape)} names "
+                        f"mesh axes {sorted(bad_axes)} outside the rules "
+                        f"table (dp/fsdp/sp/ep/tp) — a typo'd axis "
+                        f"silently replicates"))
+                if len(aval.shape) == CACHE_RANK:
+                    cache_constraints.append((tuple(aval.shape), norm))
+                    if norm != expected_cache:
+                        findings.append(Finding(
+                            "cache-spec-mismatch", anchor, 0,
+                            f"{name}: rank-5 cache constraint on "
+                            f"{tuple(aval.shape)} is {norm}, expected "
+                            f"CACHE_SPEC {expected_cache} — a mis-specced "
+                            f"cache still decodes correctly while XLA "
+                            f"reshuffles it every step"))
+                if not _spec_axes(norm) \
+                        and aval.size * aval.dtype.itemsize \
+                        > replicated_bytes_limit:
+                    findings.append(Finding(
+                        "oversized-replicated", anchor, 0,
+                        f"{name}: {tuple(aval.shape)} "
+                        f"({aval.size * aval.dtype.itemsize / 2**20:.1f} "
+                        f"MiB) explicitly constrained fully-replicated — "
+                        f"annotating replication on a buffer this big is "
+                        f"almost always a wrong spec"))
+            elif prim == "shard_map":
+                islands.append(eqn)
+                in_names = eqn.params.get("in_names") or ()
+                for var, names in zip(eqn.invars, in_names):
+                    aval = var.aval
+                    mapped = {int(d): tuple(str(a) for a in ax)
+                              for d, ax in dict(names).items()}
+                    if mapped:
+                        constrained_shapes.add(tuple(aval.shape))
+                    elif aval.size * aval.dtype.itemsize \
+                            > replicated_bytes_limit:
+                        findings.append(Finding(
+                            "oversized-replicated", anchor, 0,
+                            f"{name}: shard_map operand "
+                            f"{tuple(aval.shape)} "
+                            f"({aval.size * aval.dtype.itemsize / 2**20:.1f}"
+                            f" MiB) is unmapped — replicated onto every "
+                            f"chip of the island"))
+            elif prim == "scan":
+                scans.append((eqn, in_island))
+
+            for key, sub in _iter_subjaxprs(eqn.params):
+                collect(sub, in_island or prim == "shard_map")
+
+    collect(closed.jaxpr, in_island=False)
+
+    if cache_spec and not any(norm == expected_cache
+                              for _, norm in cache_constraints):
+        findings.append(Finding(
+            "cache-spec-missing", anchor, 0,
+            f"{name}: decode entry point registered with cache_spec=True "
+            f"has no rank-5 sharding_constraint matching CACHE_SPEC "
+            f"{expected_cache} — the cache's sharding is left to GSPMD "
+            f"propagation"))
+
+    if pool_spec:
+        pool_ok = 0
+        for eqn in islands:
+            in_names = eqn.params.get("in_names") or ()
+            for var, names in zip(eqn.invars, in_names):
+                if len(var.aval.shape) != CACHE_RANK:
+                    continue
+                mapped = {int(d): tuple(str(a) for a in ax)
+                          for d, ax in dict(names).items()}
+                if mapped == expected_pool:
+                    pool_ok += 1
+                else:
+                    findings.append(Finding(
+                        "island-pool-spec", anchor, 0,
+                        f"{name}: island pool operand "
+                        f"{tuple(var.aval.shape)} mapped {mapped}, "
+                        f"expected the kv-heads dim only "
+                        f"{expected_pool} (POOL_SPEC, from the rules "
+                        f"table) — any other mapping splits pages or "
+                        f"layers across chips and the host block tables "
+                        f"stop addressing them"))
+        if not islands:
+            findings.append(Finding(
+                "island-missing", anchor, 0,
+                f"{name}: entry point registered with pool_spec=True "
+                f"contains no shard_map island — the sharded dispatch "
+                f"degraded to a replicated program"))
+        elif not pool_ok and not any(f.rule == "island-pool-spec"
+                                     for f in findings):
+            findings.append(Finding(
+                "island-pool-spec", anchor, 0,
+                f"{name}: island carries no rank-5 pool operand mapped "
+                f"{expected_pool} — the pool is not sharded through "
+                f"the island"))
+
+    for eqn, in_island in scans:
+        if in_island:
+            continue
+        num_consts = eqn.params.get("num_consts", 0)
+        num_carry = eqn.params.get("num_carry", 0)
+        for var in eqn.invars[num_consts:num_consts + num_carry]:
+            aval = var.aval
+            if len(aval.shape) >= 3 and aval.size > carry_elems_limit \
+                    and tuple(aval.shape) not in constrained_shapes:
+                findings.append(Finding(
+                    "unconstrained-scan-carry", anchor, 0,
+                    f"{name}: scan carries {tuple(aval.shape)} "
+                    f"({aval.size} elements) with no sharding constraint "
+                    f"anywhere in the program — GSPMD free-propagates "
+                    f"through the loop, typically replicating the "
+                    f"largest buffer in the program onto every chip"))
+    return findings
+
+
+def audit_sharded_callable(fn, args: Sequence, name: str,
+                           **expect) -> List[Finding]:
+    """Trace ``fn(*args)`` and audit the result; tracing failures become
+    findings so one broken entry point cannot hide the others."""
+    import jax
+
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # noqa: BLE001 — report, keep auditing
+        return [Finding("gspmd-trace-error", f"<gspmd:{name}>", 0,
+                        f"could not trace {name}: {type(e).__name__}: "
+                        f"{str(e)[:300]}")]
+    return audit_sharded_jaxpr(closed, name, **expect)

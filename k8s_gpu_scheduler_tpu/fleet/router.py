@@ -80,7 +80,7 @@ from ..metrics.exporter import (
     FLEET_FAILOVERS_TOTAL, FLEET_GAUGES, FLEET_JOURNAL_SIZE,
     FLEET_LOST_TOTAL, FLEET_MIGRATED_TOTAL, FLEET_REPLAYED_TOKENS_TOTAL,
     FLEET_REPLICA_STATE, FLEET_ROUTED_TOTAL, FLEET_SHED_TOTAL,
-    export_serving_pool,
+    export_decode_fallbacks, export_serving_pool,
 )
 from ..models.lifecycle import (
     load_journal, persist_journal, resume_or_fresh,
@@ -322,6 +322,14 @@ class Router:
         Store failures are counted and swallowed: the registry client is
         retry-bounded, and an unreachable summary plane must degrade
         routing, never kill serving."""
+        if self._metrics is not None:
+            # Process-level (not per-replica): fused→dense downgrade
+            # decisions, by reason — the never-silent gate of
+            # serving._note_decode_fallback.
+            from ..models.serving import decode_fallback_counts
+
+            export_decode_fallbacks(self._metrics,
+                                    decode_fallback_counts())
         reps = ([self._replica(replica_id)] if replica_id is not None
                 else list(self._replicas.values()))
         for rep in reps:

@@ -66,6 +66,12 @@ class ReplicaSummary:
     # field the router keeps landing new long prompts on it. Default 0
     # keeps pre-chunking summaries parsing.
     prefill_backlog_tokens: int = 0
+    # Island width (multi-chip sharded serving, models/serving.py
+    # mesh=): replicas of different tp coexist in one fleet — snapshots
+    # are mesh-agnostic, so shed/failover crosses tp boundaries freely —
+    # and operators read this to tell scale-UP replicas from scale-OUT
+    # ones. Default 1 keeps pre-sharding summaries parsing.
+    tp: int = 1
     # [(token path, full cached token length)], hottest first.
     digest: List[Tuple[List[int], int]] = field(default_factory=list)
 
@@ -110,6 +116,7 @@ def summarize(engine, replica: str, fleet: str = "fleet", seq: int = 0,
         decode_p50_s=float(decode_p50_s),
         prefill_p50_s=float(prefill_p50_s),
         prefill_backlog_tokens=int(st.get("prefill_backlog_tokens", 0)),
+        tp=int(st.get("tp", 1)),
         digest=engine.cache_digest(top_k, max_tokens),
     )
 

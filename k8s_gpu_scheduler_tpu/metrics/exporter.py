@@ -247,6 +247,12 @@ SERVING_POOL_GAUGES = {
         "prompt tokens admitted but not yet prefilled (chunked prefill)",
     "prefill_chunks_total":
         "cumulative chunked-prefill dispatches (per-slot chunks)",
+    # Multi-chip sharded serving (shard_map islands over tp): island
+    # width and the PER-CHIP pool residency — the 1/tp scaling the
+    # sharded_decode bench leg CI-asserts.
+    "tp": "tensor-parallel island width (1 = single-chip)",
+    "kv_pool_device_bytes":
+        "per-chip KV pool residency (pool + scale-plane shard bytes)",
     "spec_accept_rate": "speculative proposals accepted / proposed",
     "spec_tokens_per_dispatch":
         "tokens committed per active slot per verify dispatch",
@@ -316,6 +322,50 @@ def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
             buckets=PHASE_BUCKETS)
         for phase, seconds in phases:
             hist.observe(float(seconds), phase=str(phase), **labels)
+
+
+# Decode fused→dense downgrade visibility (models/serving.py
+# _note_decode_fallback): a config that asks for the Pallas decode kernel
+# and silently gets the dense path is a quiet ~10x on cache traffic — the
+# counter makes it a dashboard fact instead of a code-reading exercise.
+DECODE_FALLBACK_TOTAL = "tpu_serve_decode_fallback_total"
+
+
+def export_decode_fallbacks(registry: "Registry",
+                            counts: Dict[str, float],
+                            labels: Optional[Dict[str, str]] = None) -> None:
+    """Publish ``serving.decode_fallback_counts()`` as the labeled
+    counter ``tpu_serve_decode_fallback_total{reason=}``. The source is
+    an absolute process-level count (downgrade DECISIONS, taken at
+    trace/engine-build time), so the export incs the delta since the
+    last publish — idempotent across scrapes. The baseline is a
+    watermark kept ON the registry's counter instance, NOT the counter
+    value read back: the source can be RESET
+    (serving.reset_decode_fallback_counts — a test-isolation
+    affordance, not a production path), and a counter-read baseline
+    would silently swallow every downgrade after a reset until the
+    count re-exceeded the old watermark. With the watermark, a reset
+    observed below the old mark re-bases and the new counts export as
+    fresh increments; downgrades that both reset AND regrow past the
+    old mark between two exports are indistinguishable from monotonic
+    growth and export as the partial delta — the unavoidable limit of
+    delta-exporting a resettable source, acceptable because nothing
+    resets in production."""
+    labels = labels or {}
+    c = registry.counter(
+        DECODE_FALLBACK_TOTAL,
+        "decode_attn='fused' configs downgraded to the dense path, "
+        "by reason")
+    marks = getattr(c, "_export_watermark", None)
+    if marks is None:
+        marks = c._export_watermark = {}
+    for reason, n in counts.items():
+        key = tuple(sorted({**labels, "reason": str(reason)}.items()))
+        last = marks.get(key, 0.0)
+        delta = float(n) - last if float(n) >= last else float(n)
+        if delta > 0:
+            c.inc(delta, reason=str(reason), **labels)
+        marks[key] = float(n)
 
 
 # Fleet-router counters (fleet/router.py increments these; the names are

@@ -67,9 +67,12 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..obs import FlightRecorder, SYSTEM_CLOCK
+from ..parallel.sharding import (
+    DEFAULT_RULES, KV_POOL_AXES, shard_map as _shard_map, spec_for,
+)
 from ..ops.decode_attention import (
     DEFAULT_PAGE_SIZE, contiguous_as_paged, decode_plan,
     dense_decode_reference, dense_verify_reference, flash_decode_attention,
@@ -88,6 +91,69 @@ _NEG_INF = -1e30
 
 # Cache layout [L, B, S, Hkv, hd]: batch over (dp, fsdp), kv heads over tp.
 CACHE_SPEC = P(None, ("dp", "fsdp"), None, "tp", None)
+
+# Paged pool layout [L, n_pages, ps, Hkv, hd]: KV HEADS over tp, everything
+# else replicated — DERIVED from parallel/sharding.py's rules table
+# (`spec_for(KV_POOL_AXES, DEFAULT_RULES)` — the same "kv_heads → tp"
+# entry every activation uses), so each chip holds Hkv/tp heads of EVERY
+# page and the Pallas decode/verify kernels run unchanged per shard
+# inside a shard_map island (pallas_call does not partition under GSPMD;
+# shard_map makes the shards explicit). The graftcheck GSPMD audit
+# derives its expected island mapping from the same table, so the
+# runtime and the guard rail cannot drift. Normalized (trailing None
+# trimmed): shard_map outputs come back with trailing replicated axes
+# trimmed from the spec, and the donated-through pool must keep ONE jit
+# cache key across dispatches — an un-normalized initial placement would
+# retrace once at the first output→input hand-back.
+TP_AXIS = str(DEFAULT_RULES["kv_heads"])
+
+
+def _trim_spec(spec: P) -> P:
+    entries = list(spec)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+POOL_SPEC = _trim_spec(spec_for(KV_POOL_AXES, DEFAULT_RULES))
+
+
+# -- fused→dense downgrade visibility -----------------------------------------
+#
+# A config that ASKS for decode_attn="fused" and silently gets the dense
+# path is a quiet ~10x on cache traffic. Every downgrade decision funnels
+# through here: counted per reason (exported as
+# tpu_serve_decode_fallback_total{reason=}) and warned ONCE per reason per
+# process. Decisions happen at trace/engine-build time — per compiled
+# program, not per token — so the counter measures configs that lost the
+# kernel, not traffic.
+_decode_fallback_counts: Dict[str, int] = {}
+
+
+def _note_decode_fallback(reason: str) -> None:
+    import warnings
+
+    first = reason not in _decode_fallback_counts
+    _decode_fallback_counts[reason] = \
+        _decode_fallback_counts.get(reason, 0) + 1
+    if first:
+        warnings.warn(
+            f"decode_attn='fused' downgraded to the dense path "
+            f"(reason={reason}): the config asked for the Pallas decode "
+            f"kernel and is not getting it — see "
+            f"tpu_serve_decode_fallback_total{{reason={reason!r}}}",
+            RuntimeWarning, stacklevel=3)
+
+
+def decode_fallback_counts() -> Dict[str, int]:
+    """{reason: downgrade decisions} since process start (or the last
+    reset) — the exporter maps this onto the labeled
+    ``tpu_serve_decode_fallback_total`` counter."""
+    return dict(_decode_fallback_counts)
+
+
+def reset_decode_fallback_counts() -> None:
+    _decode_fallback_counts.clear()
 
 
 def init_cache(cfg: LlamaConfig, batch: int,
@@ -169,11 +235,17 @@ def forward_with_cache(
     the training forward wherever training didn't drop."""
     B, t = tokens.shape
     pos = cache["len"]
-    # Fused Pallas decode attention only off-mesh: pallas_call does not
-    # partition under GSPMD, so sharded caches keep the dense einsum path
-    # (XLA shards it like any other activation).
-    attn_impl = getattr(cfg, "decode_attn", "dense") if mesh is None \
-        else "dense"
+    # Fused Pallas decode attention only off-mesh HERE: pallas_call does
+    # not partition under GSPMD, so a mesh-CONSTRAINED contiguous cache
+    # keeps the dense einsum path (XLA shards it like any other
+    # activation). The downgrade is counted + warned — never silent. The
+    # PAGED engine serves fused ON a mesh through its shard_map islands
+    # (ContinuousBatcher(mesh=...)); this gate covers only the static
+    # generate/contiguous path.
+    attn_impl = getattr(cfg, "decode_attn", "dense")
+    if attn_impl == "fused" and mesh is not None:
+        _note_decode_fallback("mesh_constrained_cache")
+        attn_impl = "dense"
     angles = jax.lax.dynamic_slice_in_dim(
         rope_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta), pos, t, 0)
     x = params["embed"][tokens].astype(cfg.dtype)
@@ -430,10 +502,14 @@ def _decode_chunk_fn(params, cfg: LlamaConfig, chunk: int,
     S = k.shape[2]
     # Fused Pallas decode kernel (ops/decode_attention.py) when the config
     # asks for it, the cache is unsharded (pallas_call does not partition
-    # under GSPMD) and the blocking covers S; else the grouped dense
-    # reference — EITHER way no _repeat_kv materialization.
+    # under GSPMD; the PAGED engine is the sharded fused path) and the
+    # blocking covers S; else the grouped dense reference — EITHER way no
+    # _repeat_kv materialization. A downgrade is counted + warned.
     fused = (getattr(cfg, "decode_attn", "dense") == "fused"
              and mesh is None and decode_plan(S) is not None)
+    if getattr(cfg, "decode_attn", "dense") == "fused" and not fused:
+        _note_decode_fallback(
+            "mesh_contiguous" if mesh is not None else "no_contiguous_plan")
     angles_full = rope_freqs(cfg.head_dim, S, cfg.rope_theta)
     col = jnp.arange(S)[None, :]
     base_key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
@@ -628,10 +704,19 @@ def _prefill_multi_fn(params, cfg: LlamaConfig, mesh: Optional[Mesh],
 # are garbage by contract and only ever read under a mask.
 
 
+def _tp_heads(x, tp_axis: str, n_local: int, axis: int):
+    """This shard's contiguous head block of a full-head projection: the
+    q heads of kv head h are the contiguous group h·g..h·g+g-1, so a
+    contiguous slice of H/tp q heads (or Hkv/tp kv heads) is exactly the
+    head family this shard's pool slice serves, for q and kv alike."""
+    return jax.lax.dynamic_slice_in_dim(
+        x, jax.lax.axis_index(tp_axis) * n_local, n_local, axis)
+
+
 def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
                            page_size: int, k, v, table, lens, last, active,
                            seed, temperature: float = 0.0, top_k: int = 0,
-                           k_s=None, v_s=None):
+                           k_s=None, v_s=None, tp_axis=None, tp: int = 1):
     """Advance every active slot ``chunk`` tokens against the paged pool
     k/v [L, n_pages, ps, Hkv, hd] with block table [B, n_blocks] and
     per-slot filled lengths [B]. The table is read-only here (pages are
@@ -639,7 +724,21 @@ def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
     it through; ``lens`` advances per active slot per tick and is the rope
     position, the write address, and the attention length bound at once —
     the cursor/bitmap/rope_pos triple of the contiguous engine collapsed
-    into one vector."""
+    into one vector.
+
+    ``tp_axis`` non-None = MULTI-CHIP island mode: this body runs inside
+    a ``shard_map`` over that mesh axis with the pool (and scale planes)
+    sharded on the kv-heads dim ([L, n_pages, ps, Hkv/tp, hd] per shard)
+    and every other operand replicated. Each shard computes the FULL
+    q/k/v projections from the replicated weights (identical on every
+    chip), slices its own contiguous head family (_tp_heads), writes its
+    kv-head slice into its pool shard, and runs the UNCHANGED kernel
+    body on local shapes; the per-head attention outputs are then
+    ``all_gather``ed back to the full head set — an exact (movement-only,
+    no-arithmetic) combine, so the sharded stream is byte-identical to
+    the unsharded one — and the residual/mlp/logit tail proceeds
+    replicated. The decode step's dominant cost — the O(pos) pool read —
+    is what shards 1/tp; per-chip pool residency shards with it."""
     quant = k_s is not None
     B = last.shape[0]
     n_blocks = table.shape[1]
@@ -647,10 +746,14 @@ def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
     fused = (getattr(cfg, "decode_attn", "dense") == "fused"
              and cfg.n_heads % cfg.n_kv_heads == 0
              and paged_plan(n_blocks, page_size) is not None)
+    if getattr(cfg, "decode_attn", "dense") == "fused" and not fused:
+        _note_decode_fallback("no_paged_plan")
     angles_full = rope_freqs(cfg.head_dim, S, cfg.rope_theta)
     row_ids = jnp.arange(B)
     base_key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
     active_i = jnp.asarray(active)
+    h_loc = cfg.n_heads // tp
+    hkv_loc = cfg.n_kv_heads // tp
 
     def one_token(carry, tick):
         k, v, k_s, v_s, lens, last = carry
@@ -672,6 +775,15 @@ def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
             kk = qdot(h, blk["wk"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
             vv = qdot(h, blk["wv"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
             q, kk = apply_rope(q, angles), apply_rope(kk, angles)
+            if tp_axis is not None:
+                # Island mode: this shard's contiguous head family. The
+                # full projections above are computed from replicated
+                # inputs — identical on every chip — so the slice is the
+                # only divergence, and the kernel below sees exactly the
+                # per-shard pool shapes.
+                q = _tp_heads(q, tp_axis, h_loc, 2)
+                kk = _tp_heads(kk, tp_axis, hkv_loc, 2)
+                vv = _tp_heads(vv, tp_axis, hkv_loc, 2)
             if quant:
                 kq, ksn = _kv_quant(kk)
                 vq, vsn = _kv_quant(vv)
@@ -701,6 +813,13 @@ def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
                 attn = dense_decode_reference(
                     q[:, 0], gather_paged_kv(k_pg, table),
                     gather_paged_kv(v_pg, table), lengths=lens + 1, **dsc)
+            if tp_axis is not None:
+                # Exact combine: per-head outputs are complete within
+                # their shard (each q head's whole kv-head group is
+                # local), so reassembling the head axis is data movement
+                # only — no cross-shard arithmetic, hence byte identity
+                # with the unsharded program.
+                attn = jax.lax.all_gather(attn, tp_axis, axis=1, tiled=True)
             x = x + qdot(attn.reshape(B, 1, cfg.n_heads * cfg.head_dim),
                          blk["wo"])
             x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
@@ -725,7 +844,8 @@ def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
 
 def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
                            page_size: int, k, v, table, lens, last, props,
-                           active, k_s=None, v_s=None):
+                           active, k_s=None, v_s=None, tp_axis=None,
+                           tp: int = 1):
     """One batched speculative VERIFY dispatch over every slot of the
     paged pool: score the t = 1+gamma window [last, props...] of each
     active slot in a single forward, accept the longest proposal prefix
@@ -754,7 +874,13 @@ def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
     at __init__), so no PRNG state rides along. Returns the donated pool
     /scale/table chain plus per-slot ``emitted`` [B, 1+gamma] (-1 past
     the commit length and for inactive slots) and ``accepts`` [B] (the
-    number of PROPOSALS accepted, 0..gamma)."""
+    number of PROPOSALS accepted, 0..gamma).
+
+    ``tp_axis`` non-None = shard_map island mode, exactly the decode
+    chunk's contract (_decode_chunk_paged_fn): pool/scales sharded on kv
+    heads, full projections sliced to this shard's head family, kernel
+    body unchanged on local shapes, attention heads ``all_gather``ed back
+    (exact combine — byte identity), accept/commit math replicated."""
     quant = k_s is not None
     B = last.shape[0]
     t = 1 + gamma
@@ -763,6 +889,10 @@ def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
     fused = (getattr(cfg, "decode_attn", "dense") == "fused"
              and cfg.n_heads % cfg.n_kv_heads == 0
              and verify_plan(n_blocks, page_size, t) is not None)
+    if getattr(cfg, "decode_attn", "dense") == "fused" and not fused:
+        _note_decode_fallback("no_verify_plan")
+    h_loc = cfg.n_heads // tp
+    hkv_loc = cfg.n_kv_heads // tp
     angles_full = rope_freqs(cfg.head_dim, S, cfg.rope_theta)
     row_ids = jnp.arange(B)
     active_i = jnp.asarray(active)
@@ -787,6 +917,13 @@ def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
         kk = qdot(h, blk["wk"]).reshape(B, t, cfg.n_kv_heads, cfg.head_dim)
         vv = qdot(h, blk["wv"]).reshape(B, t, cfg.n_kv_heads, cfg.head_dim)
         q, kk = apply_rope(q, angles), apply_rope(kk, angles)
+        if tp_axis is not None:
+            # Island mode: this shard's head family (see
+            # _decode_chunk_paged_fn — same slice, t window rows instead
+            # of one).
+            q = _tp_heads(q, tp_axis, h_loc, 2)
+            kk = _tp_heads(kk, tp_axis, hkv_loc, 2)
+            vv = _tp_heads(vv, tp_axis, hkv_loc, 2)
         if quant:
             kq, ksn = _kv_quant(kk)
             vq, vsn = _kv_quant(vv)
@@ -812,6 +949,9 @@ def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
             attn = dense_verify_reference(
                 q, gather_paged_kv(k_pg, table),
                 gather_paged_kv(v_pg, table), lens, **dsc)
+        if tp_axis is not None:
+            # Exact head-axis reassembly (movement only — byte identity).
+            attn = jax.lax.all_gather(attn, tp_axis, axis=2, tiled=True)
         x = x + qdot(attn.reshape(B, t, cfg.n_heads * cfg.head_dim),
                      blk["wo"])
         x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
@@ -840,7 +980,8 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
                             k, v, lens, last, slots, page_ids,
                             prefix_tables, hit_lens, tokens, tail_lens,
                             seed, temperature: float = 0.0,
-                            top_k: int = 0, k_s=None, v_s=None):
+                            top_k: int = 0, k_s=None, v_s=None,
+                            tp_axis=None, tp: int = 1):
     """Prefill M freed slots from right-padded prompts [M, tb] in ONE
     dispatch, paged edition: the batched mini cache computes every
     prompt's K/V exactly as the contiguous path, then ONE page-granular
@@ -879,12 +1020,22 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
     attends) where the cache-off full prefill attends its pre-
     quantization bf16 mini cache — greedy argmax only flips on a
     near-exact logit tie, and the parity tests pin it, but it is
-    quantization-noise-bounded rather than structural."""
+    quantization-noise-bounded rather than structural.
+
+    ``tp_axis`` non-None = shard_map island mode (the decode chunk's
+    contract): the pool/scale scatter targets are per-shard kv-head
+    slices, so the hb == 0 path computes the batched mini cache
+    replicated (identical on every chip) and slices this shard's kv-head
+    family at the scatter, while the hb > 0 tail attends the LOCAL
+    prefix heads with the matching local q family and ``all_gather``s
+    the head axis back before the output projection — exact combines
+    throughout, so sharded prefill is byte-identical per shard slice."""
     quant = k_s is not None
     B = last.shape[0]
     M, tb = tokens.shape
     npg = page_ids.shape[1]
     hb = prefix_tables.shape[1]
+    hkv_loc = cfg.n_kv_heads // tp
     if hb == 0:
         # Plain path: tokens are whole prompts, nothing cached.
         mini = {
@@ -897,6 +1048,13 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
         logits, mini = forward_with_cache(params, tokens, cfg, mini,
                                           mesh=None)
         mk, mv = mini["k"], mini["v"]
+        if tp_axis is not None:
+            # Replicated full-head mini cache → this shard's kv-head
+            # slice, the rows its pool shard stores ([L, M, tb, Hkv/tp,
+            # hd] — a slice of the exact bytes the unsharded path
+            # scatters).
+            mk = _tp_heads(mk, tp_axis, hkv_loc, 3)
+            mv = _tp_heads(mv, tp_axis, hkv_loc, 3)
     else:
         hp = hb * page_size
         g = cfg.n_heads // cfg.n_kv_heads
@@ -937,7 +1095,18 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
             vv = qdot(h, blk["wv"]).reshape(M, tb, cfg.n_kv_heads,
                                             cfg.head_dim)
             q, kk = apply_rope(q, angles), apply_rope(kk, angles)
-            qg = q.reshape(M, tb, cfg.n_kv_heads, g, cfg.head_dim)
+            if tp_axis is not None:
+                # Island mode: the gathered prefix (pk_l/pv_l) is this
+                # shard's kv-head slice of the pool, so the tail's q/k/v
+                # slice to the matching head family; the scan ys (kk, vv)
+                # stay local — they are exactly the rows this shard's
+                # pool scatter stores.
+                q = _tp_heads(q, tp_axis,
+                              (cfg.n_heads // tp), 2)
+                kk = _tp_heads(kk, tp_axis, hkv_loc, 2)
+                vv = _tp_heads(vv, tp_axis, hkv_loc, 2)
+            h_kv = kk.shape[2]
+            qg = q.reshape(M, tb, h_kv, g, cfg.head_dim)
             kf = jnp.concatenate([pk_l, kk], axis=1)   # [M, hp+tb, Hkv, hd]
             vf = jnp.concatenate([pv_l, vv], axis=1)
             scores = jnp.einsum(
@@ -945,6 +1114,11 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
             scores = jnp.where(valid[:, None, None], scores, _NEG_INF)
             probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
             attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
+            if tp_axis is not None:
+                # Exact head-axis reassembly ([M, tb, Hkv/tp, g, hd] →
+                # full kv-major head order — movement only).
+                attn = jax.lax.all_gather(attn, tp_axis, axis=2,
+                                          tiled=True)
             x = x + qdot(attn.reshape(M, tb, cfg.n_heads * cfg.head_dim),
                          blk["wo"])
             x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
@@ -1032,7 +1206,23 @@ class ContinuousBatcher:
     decode/verify dispatches. This bounds the worst-case decode-step
     latency by the chunk budget regardless of arriving prompt length —
     the TTFT/decode-interference fix (Sarathi-Serve/DistServe), and
-    stage (a) of the ROADMAP disaggregation item."""
+    stage (a) of the ROADMAP disaggregation item.
+
+    ``mesh=`` (paged layout) turns on MULTI-CHIP SHARDED serving: every
+    dispatch wraps in a ``shard_map`` island over the mesh's ``tp`` axis
+    with the pool + scale planes sharded on the kv-heads dim
+    ([L, n_pages, ps, Hkv/tp, hd] per chip — POOL_SPEC) and the block
+    table / ``lens`` / ``last`` replicated. The Pallas kernel bodies run
+    unchanged per shard on their local head family; attention heads
+    reassemble via exact all_gathers, so sharded streams are
+    byte-identical to unsharded ones, donation and zero-retrace survive
+    the island boundary, and admission / chunked prefill / prefix
+    mounting / speculative rewind — all host-side block-table and lens
+    edits — are shard-agnostic and run untouched. Per-chip pool
+    residency scales 1/tp: the scale-UP axis no single chip provides
+    (the fleet tier is the scale-OUT axis). Snapshots stay mesh-agnostic
+    (drain gathers full kv heads), so shed/failover works across
+    replicas of different tp."""
 
     def __init__(self, params, cfg: LlamaConfig, n_slots: int = 8,
                  max_len: Optional[int] = None, chunk: int = 8,
@@ -1159,15 +1349,28 @@ class ContinuousBatcher:
             # grown incrementally as tokens commit (see _propose).
             self._spec_mirror = {}
         self.S = min(max_len or cfg.max_seq, cfg.max_seq)
+        # Multi-chip sharded paged serving: a mesh with a 'tp' axis wraps
+        # every paged dispatch (decode chunk / verify window / (tb, hb)
+        # prefill rung) in a shard_map island with the pool + scale
+        # planes sharded POOL_SPEC (kv heads over tp) and everything
+        # host-legible — block table, lens, last, prompts — replicated,
+        # so admission, chunked prefill, prefix-cache mounting and
+        # speculative rewind are shard-agnostic and run untouched.
+        self._mesh = mesh if kv_layout == "paged" else None
+        self._tp = 1
+        if self._mesh is not None:
+            if TP_AXIS not in self._mesh.shape:
+                raise ValueError(
+                    f"sharded paged serving needs a mesh with a "
+                    f"'{TP_AXIS}' axis; got axes "
+                    f"{tuple(self._mesh.axis_names)}")
+            tp = int(self._mesh.shape[TP_AXIS])
+            if cfg.n_kv_heads % tp:
+                raise ValueError(
+                    f"kv heads ({cfg.n_kv_heads}) not divisible by "
+                    f"tp={tp}: the pool shards on the kv-heads dim")
+            self._tp = tp
         if kv_layout == "paged":
-            if mesh is not None:
-                # pallas_call does not partition under GSPMD and the pool
-                # is not a per-slot activation the CACHE_SPEC rules cover;
-                # sharded serving keeps the contiguous layout for now
-                # (ROADMAP: fused decode under GSPMD).
-                raise NotImplementedError(
-                    "kv_layout='paged' requires unsharded serving "
-                    "(mesh=None)")
             if self.S % page_size:
                 raise ValueError(
                     f"cache capacity {self.S} not divisible by page_size "
@@ -1191,6 +1394,12 @@ class ContinuousBatcher:
                 self._k = jnp.zeros(pool, cfg.dtype)
                 self._v = jnp.zeros(pool, cfg.dtype)
                 self._ks = self._vs = None
+            if self._mesh is not None:
+                # Shard the pool across the island's mesh from birth:
+                # each chip holds [L, n_pages, ps, Hkv/tp, hd] — pool
+                # residency scales 1/tp, the capacity headroom the whole
+                # feature exists for.
+                self._reshard_pool()
             # Host mirror of the block table; the device copy is uploaded
             # (4 bytes/block — KiBs) only on steps whose admissions/frees
             # changed it, and otherwise donated through decode dispatches
@@ -1258,6 +1467,8 @@ class ContinuousBatcher:
             self._cursor = 0
             self._rope_pos = jnp.zeros((n_slots,), jnp.int32)
         self._last = jnp.zeros((n_slots,), jnp.int32)
+        if self._mesh is not None:
+            self._pin_host_state()
         # Host-side bookkeeping (active mask is derived from it each chunk).
         self._slot_req: Dict[int, int] = {}          # slot -> req id
         self._budget: Dict[int, int] = {}            # req id -> tokens left
@@ -1285,33 +1496,50 @@ class ContinuousBatcher:
         temp, tk = self.temperature, self.top_k
         if kv_layout == "paged":
             ps = self.page_size
+            # Island mode threads the tp axis through the dispatch
+            # bodies; PS_/RE_ are the pool-sharded / replicated specs the
+            # shard_map wrapper (_jit_island) binds per operand.
+            tp_kw = ({} if self._mesh is None
+                     else dict(tp_axis=TP_AXIS, tp=self._tp))
+            PS_, RE_ = POOL_SPEC, P()
             if self.spec:
                 gm = self.gamma
                 # The verify dispatch replaces the decode chunk: one
                 # (1+gamma)-window forward per step instead of `chunk`
                 # single-token ticks; the donation contract is identical
                 # (pool + scales + table consumed every dispatch).
-                self._decode = jax.jit(
+                self._decode = self._jit_island(
                     lambda p, k, v, ks, vs, tbl, lens, last, props, active:
                     _verify_chunk_paged_fn(
                         p, cfg, gm, ps, k, v, tbl, lens, last, props,
-                        active, k_s=ks, v_s=vs),
-                    donate_argnums=(1, 2, 3, 4, 5),
+                        active, k_s=ks, v_s=vs, **tp_kw),
+                    in_specs=(RE_, PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_,
+                              RE_),
+                    out_specs=(PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_,
+                               RE_),
+                    donate=(1, 2, 3, 4, 5),
                 )
             else:
-                self._decode = jax.jit(
+                self._decode = self._jit_island(
                     lambda p, k, v, ks, vs, tbl, lens, last, active, seed:
                     _decode_chunk_paged_fn(
                         p, cfg, chunk, ps, k, v, tbl, lens, last, active,
-                        seed, temp, tk, k_s=ks, v_s=vs),
-                    donate_argnums=(1, 2, 3, 4, 5),
+                        seed, temp, tk, k_s=ks, v_s=vs, **tp_kw),
+                    in_specs=(RE_, PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_,
+                              RE_),
+                    out_specs=(PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_),
+                    donate=(1, 2, 3, 4, 5),
                 )
-            self._prefill = jax.jit(
+            self._prefill = self._jit_island(
                 lambda p, k, v, ks, vs, lens, last, slots, pids, ptbl,
                 hlens, tokens, tlens, seed: _prefill_multi_paged_fn(
                     p, cfg, ps, k, v, lens, last, slots, pids, ptbl,
-                    hlens, tokens, tlens, seed, temp, tk, k_s=ks, v_s=vs),
-                donate_argnums=(1, 2, 3, 4),
+                    hlens, tokens, tlens, seed, temp, tk, k_s=ks, v_s=vs,
+                    **tp_kw),
+                in_specs=(RE_, PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_,
+                          RE_, RE_, RE_, RE_, RE_),
+                out_specs=(PS_, PS_, PS_, PS_, RE_, RE_, RE_),
+                donate=(1, 2, 3, 4),
             )
         else:
             self._decode = jax.jit(
@@ -1328,6 +1556,59 @@ class ContinuousBatcher:
                     real_lens, seed, temp, tk, k_s=ks, v_s=vs),
                 donate_argnums=(1, 2, 3, 4, 5),
             )
+
+    # -- multi-chip islands ------------------------------------------------
+    def _jit_island(self, fn, in_specs, out_specs, donate):
+        """jit one paged dispatch — wrapped in the multi-chip shard_map
+        island when a mesh is attached. Donation goes through the island
+        boundary: the pool/scale inputs and outputs carry the same
+        POOL_SPEC sharding, so jit aliases the per-chip buffers exactly
+        as it does the single-chip ones, and the table rides donated-
+        through replicated. Every non-pool output is computed replicated
+        inside the body (the only cross-shard ops are the exact
+        all_gather head combines), so replicated out_specs are sound;
+        ``check_vma=False`` matches the repo's other islands — 0.4.x
+        ``check_rep`` cannot see through the axis_index-driven head
+        slices."""
+        if self._mesh is None:
+            return jax.jit(fn, donate_argnums=donate)
+        return jax.jit(
+            _shard_map(fn, mesh=self._mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False),
+            donate_argnums=donate)
+
+    def _reshard_pool(self) -> None:
+        """Pin the pool (+ scale planes) onto the island's POOL_SPEC
+        placement. The initial allocation and every restore/absorb
+        scatter funnel through here: eager ``.at[].set`` updates pick
+        their own output sharding, and the island jit keys on input
+        shardings — so re-pinning is simultaneously the "re-shard onto
+        the target's mesh" half of snapshot portability (a tp=2 snapshot
+        restores onto a tp=4 mesh by landing its host pages through this
+        put) and what keeps steady-state dispatches on one compiled
+        program. device_put onto an identical sharding is a no-op."""
+        sh = NamedSharding(self._mesh, POOL_SPEC)
+        # graftcheck: ignore[host-sync] — sanctioned: engine-birth/restore-boundary placement (never in the step loop); identical-sharding re-pins are no-ops
+        self._k = jax.device_put(self._k, sh)
+        self._v = jax.device_put(self._v, sh)  # graftcheck: ignore[host-sync] — sanctioned: same placement boundary
+        if self._ks is not None:
+            # graftcheck: ignore[host-sync] — sanctioned: same placement boundary (scale planes)
+            self._ks = jax.device_put(self._ks, sh)
+            self._vs = jax.device_put(self._vs, sh)  # graftcheck: ignore[host-sync] — sanctioned: same placement boundary
+
+    def _pin_host_state(self) -> None:
+        """Commit ``lens``/``last`` replicated onto the island mesh. jit
+        keys include committed shardings, and these vectors alternate
+        between host-built values (engine birth, restore/absorb writes)
+        and donated-through island outputs — pinning both forms onto the
+        same replicated placement keeps steady state on ONE compiled
+        program instead of retracing at every host-write boundary."""
+        if self._mesh is None:
+            return
+        rep = NamedSharding(self._mesh, P())
+        # graftcheck: ignore[host-sync] — sanctioned: engine-birth/restore-boundary committal of two [n_slots] vectors (never in the step loop)
+        self._lens = jax.device_put(self._lens, rep)
+        self._last = jax.device_put(self._last, rep)  # graftcheck: ignore[host-sync] — sanctioned: same committal boundary
 
     # -- API ---------------------------------------------------------------
     def _ladder(self, prompt_len: int) -> int:
@@ -2413,7 +2694,11 @@ class ContinuousBatcher:
         reservations for (chunk, spec, gamma). ``n_pages`` is recorded
         but EXEMPT from the restore check — pages are re-laid-out through
         the fresh allocator, so pool size may differ (snapshot.py
-        check_fingerprint). ``prefill_chunk_tokens`` is deliberately NOT
+        check_fingerprint). The MESH/tp width is deliberately NOT
+        recorded at all: drain gathers the full kv-head dim to host, so
+        a snapshot is mesh-agnostic by construction and restores across
+        heterogeneous replicas (tp=2 → tp=1 → tp=4) — the fleet
+        shed/failover story across mixed replica shapes depends on it. ``prefill_chunk_tokens`` is deliberately NOT
         part of the contract: chunking is a pure scheduling knob — a
         chunked engine's mid-prefill snapshot restores into an unchunked
         one (the tail prefills in one dispatch) and vice versa, with no
@@ -2698,6 +2983,7 @@ class ContinuousBatcher:
         self._table_dirty = True
         self._lens = jnp.asarray(snap.lens, jnp.int32)
         self._last = jnp.asarray(snap.last, jnp.int32)
+        self._pin_host_state()
         remap = lambda pages: [int(lut[p]) for p in pages]  # noqa: E731
         if snap.tree_paths and self._prefix is None:
             raise SnapshotError(
@@ -2784,6 +3070,13 @@ class ContinuousBatcher:
                     jnp.asarray(snap.k_scales, jnp.float32))
                 self._vs = self._vs.at[:, idx].set(
                     jnp.asarray(snap.v_scales, jnp.float32))
+            if self._mesh is not None:
+                # Snapshot portability across mesh shapes: the shipped
+                # pages are a host pytree, mesh-agnostic by construction
+                # (drain gathers the FULL kv-head dim); landing them here
+                # re-shards onto THIS engine's tp — tp=2 → tp=1 → tp=4
+                # round trips are pure data movement.
+                self._reshard_pool()
         return lut
 
     def absorb(self, snap: ServingSnapshot) -> Dict[int, int]:
@@ -2882,6 +3175,7 @@ class ContinuousBatcher:
                 self._prefill_pending[tgt] = int(lens[tgt])
         self._lens = jnp.asarray(lens, jnp.int32)
         self._last = jnp.asarray(last, jnp.int32)
+        self._pin_host_state()
         self._table_dirty = True
         self._alloc.assert_consistent()
         self._resumed += len(mapping)
@@ -2915,6 +3209,11 @@ class ContinuousBatcher:
             # floods keep landing on one replica (the router folds a
             # discount on it into its score).
             "prefill_backlog_tokens": self._prefill_backlog(),
+            # Island width (1 = single-chip): heterogeneous fleets shed
+            # snapshots across replicas of different tp — the summary
+            # carries it so operators can see which replicas scale UP
+            # vs OUT.
+            "tp": self._tp,
         }
 
     def cache_digest(self, top_k: int = 8,
@@ -2980,6 +3279,19 @@ class ContinuousBatcher:
         # a restore/absorb re-queued a peer's mid-prefill slot.
         out["prefill_backlog_tokens"] = float(self._prefill_backlog())
         out["prefill_chunks_total"] = float(self._prefill_chunks_total)
+        # Multi-chip islands: tp width and the PER-CHIP pool residency
+        # (shard 0's bytes across pool + scale planes — metadata reads,
+        # no device sync). Unsharded engines report the whole pool; the
+        # sharded-serving bench asserts the 1/tp scaling on this gauge.
+        out["tp"] = float(self._tp)
+        dev_bytes = 0
+        for arr in (self._k, self._v, self._ks, self._vs):
+            if arr is None:
+                continue
+            shards = getattr(arr, "addressable_shards", None)
+            dev_bytes += int(shards[0].data.nbytes if shards
+                             else arr.nbytes)
+        out["kv_pool_device_bytes"] = float(dev_bytes)
         # ONE lock snapshot for everything the step loop mutates: the
         # watchdog age, the spec gauges and the drained phase batch all
         # come from the same instant, so a scrape racing a step can

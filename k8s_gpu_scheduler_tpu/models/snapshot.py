@@ -37,6 +37,14 @@ prompt + emitted stream), deferred readbacks (drain flushes them), and
 cumulative gauge counters (a restored engine starts fresh counters; the
 ``requests_resumed_total`` gauge records the handoff).
 
+Snapshots are MESH-AGNOSTIC by construction: drain gathers the full
+kv-head dim of every shipped page to host, so the payload carries no
+trace of the source engine's tp width and the fingerprint deliberately
+omits it — restore/absorb re-shard the pages onto the TARGET's mesh
+(serving._reshard_pool), which is what lets the fleet shed/failover
+across heterogeneous replicas (tp=2 → tp=1 → tp=4 round trips are
+token-identical, tests/test_sharded_serving.py).
+
 The snapshot runs through ``utils/checkpoint.py``'s orbax machinery via
 ``to_pytree``/``from_pytree``: every field becomes a numpy array (the
 host bookkeeping rides as one JSON document encoded to uint8), so

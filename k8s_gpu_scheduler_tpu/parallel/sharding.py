@@ -60,7 +60,21 @@ DEFAULT_RULES: Dict[str, object] = {
     # MoE (ops/moe.py): the stacked expert dim shards over ep — GSPMD
     # turns the dispatch/combine einsums into all_to_alls over that axis.
     "expert": "ep",
+    # Paged serving pool (models/serving.py): layer stack and page pool
+    # replicated, kv heads on tp — the activation convention ("heads" on
+    # tp) applied to the KV page pool, so each chip holds Hkv/tp heads of
+    # every page. The graftcheck GSPMD pass (analysis/gspmd.py) audits
+    # cache/pool annotations against these entries.
+    "layers": None,
+    "pages": None,
+    "page": None,
 }
+
+# Logical axes of the paged KV pool [L, n_pages, page_size, Hkv, hd] —
+# `spec_for(KV_POOL_AXES, DEFAULT_RULES)` is the pool PartitionSpec the
+# serving islands and the GSPMD audit both derive from this one table.
+KV_POOL_AXES: Tuple[str, ...] = ("layers", "pages", "page", "kv_heads",
+                                 "head_dim")
 
 
 def logical_axis_rules(overrides: Dict[str, object] = None) -> Dict[str, object]:

@@ -1,0 +1,59 @@
+"""Seeded-bad fixture: GSPMD sharding-annotation true positives.
+
+Two toy entry points, each wrong in exactly the way the gspmd pass
+exists to catch — neither produces wrong tokens, both silently cost
+memory/ICI at scale, and none of it is visible to the AST pass:
+
+- ``bad_cache_constraint`` annotates a rank-5 KV cache with ``tp`` on
+  the SEQUENCE dim instead of the kv-heads dim (``cache-spec-mismatch``
+  — XLA will happily reshuffle the cache every step to satisfy it) and
+  pins a multi-MiB buffer explicitly replicated
+  (``oversized-replicated``);
+- ``bad_scan_carry`` loops a cache-sized carry through ``lax.scan``
+  with no sharding constraint anywhere in the program
+  (``unconstrained-scan-carry`` — GSPMD free-propagates through the
+  loop, typically replicating the biggest buffer in the program onto
+  every chip).
+
+The mesh is built at whatever device count the process has (axis sizes
+clamp to 1), because the ANNOTATIONS — all this audit reads — are
+identical at any size.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _mesh():
+    devs = np.array(jax.devices()[:1])
+    return Mesh(devs.reshape((1,) * 5), ("dp", "fsdp", "sp", "ep", "tp"))
+
+
+def _bad_cache_constraint(cache, big):
+    mesh = _mesh()
+    # tp on the SEQUENCE dim of [L, B, S, Hkv, hd] — not CACHE_SPEC.
+    cache = jax.lax.with_sharding_constraint(
+        cache, NamedSharding(mesh, P(None, None, "tp", None, None)))
+    # A ~4 MiB buffer explicitly annotated fully-replicated.
+    big = jax.lax.with_sharding_constraint(
+        big, NamedSharding(mesh, P(None, None)))
+    return cache.sum() + big.sum()
+
+
+def _bad_scan_carry(x):
+    def body(carry, _):
+        return carry * 1.0001, None
+
+    out, _ = jax.lax.scan(body, x, None, length=2)
+    return out
+
+
+GRAFTCHECK_GSPMD_AUDIT = [
+    ("bad_cache_constraint", _bad_cache_constraint,
+     (jnp.zeros((2, 2, 32, 8, 8), jnp.bfloat16),
+      jnp.zeros((1024, 1024), jnp.float32)),
+     {"cache_spec": True}),
+    ("bad_scan_carry", _bad_scan_carry,
+     (jnp.zeros((2, 64, 1024), jnp.float32),), {}),
+]

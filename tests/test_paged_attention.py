@@ -394,7 +394,9 @@ class TestPagedEngine:
             assert p in eng._alloc._free
         eng._alloc.assert_consistent()
 
-    def test_paged_rejects_mesh_and_bad_page_size(self):
+    def test_paged_rejects_bad_page_size(self):
+        # (The old paged-rejects-mesh gate is gone: a mesh now selects
+        # the shard_map island path — tests/test_sharded_serving.py.)
         from k8s_gpu_scheduler_tpu.models import init_params
         from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
 

@@ -1,0 +1,363 @@
+"""Multi-chip sharded paged serving — shard_map islands over tp.
+
+Runs on XLA's forced host-platform devices (conftest: 8 CPU devices),
+which exercises the same shard_map partitioning the TPU path uses. The
+contract under test:
+
+- sharded (tp ∈ {2, 4}) streams are BYTE-IDENTICAL to the unsharded
+  engine across the full feature grid (dense/fused × int8-KV ×
+  prefix-cache × speculative × chunked prefill) — the head-slice +
+  exact-all_gather island design makes identity structural, not a
+  float-tie accident;
+- donation and zero-retrace survive the island boundary (jit keys now
+  include shardings);
+- per-chip pool residency scales exactly 1/tp;
+- snapshots are mesh-agnostic: tp=2 → tp=1 → tp=4 round trips resume
+  token-identically, and partial (shed) snapshots absorb across tp;
+- the fused→dense downgrade gate is never silent (warn-once + counted
+  metric), and the paged sharded path does NOT downgrade;
+- the graftcheck GSPMD audit passes on the tree and catches the seeded
+  bad fixture.
+"""
+import dataclasses
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from k8s_gpu_scheduler_tpu.models import serving
+from k8s_gpu_scheduler_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+
+def tp_mesh(tp: int) -> Mesh:
+    devs = jax.devices()
+    if len(devs) < tp:
+        pytest.skip(f"needs {tp} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:tp]), ("tp",))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = dataclasses.replace(LlamaConfig.tiny(), decode_attn="fused")
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def build(cfg, params, mesh, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("chunk", 2)
+    kw.setdefault("prefill_bucket", 8)
+    kw.setdefault("page_size", 8)
+    return ContinuousBatcher(params, cfg, kv_layout="paged", mesh=mesh,
+                             **kw)
+
+
+def drive(eng, prompts, max_new=4):
+    for p in prompts:
+        eng.submit(p, max_new=max_new)
+    return eng.run()
+
+
+def mixed_prompts(cfg, seed=0, n=4):
+    rng = np.random.default_rng(seed)
+    phrase = list(rng.integers(0, cfg.vocab, 3))
+    shared = list(rng.integers(0, cfg.vocab, 8))
+    out = [list(rng.integers(0, cfg.vocab, int(ln)))
+           for ln in rng.integers(4, 21, n - 2)]
+    # A prefix-sharing pair (prefix-cache hits) and a self-repetitive
+    # prompt (speculative accepts) ride every grid point.
+    out.append(shared + list(rng.integers(0, cfg.vocab, 4)))
+    out.append(phrase * 4)
+    return out
+
+
+GRID = [
+    dict(),
+    dict(kv_dtype="int8"),
+    dict(kv_dtype="int8", prefix_cache=True),
+    dict(prefix_cache=True, prefill_chunk_tokens=8),
+    dict(kv_dtype="int8", prefill_chunk_tokens=8),
+    dict(speculative=True, gamma=2),
+    dict(kv_dtype="int8", speculative=True, gamma=2, prefix_cache=True),
+    dict(dense=True, kv_dtype="int8"),
+    dict(dense=True),
+]
+
+
+@pytest.mark.parametrize("kw", GRID,
+                         ids=lambda kw: "-".join(sorted(
+                             k for k, v in kw.items() if v)) or "plain")
+def test_sharded_byte_identity_grid(tiny, kw):
+    """tp=2 == unsharded, byte for byte, across the feature grid."""
+    cfg, params = tiny
+    kw = dict(kw)
+    if kw.pop("dense", False):
+        cfg = dataclasses.replace(cfg, decode_attn="dense")
+    prompts = mixed_prompts(cfg)
+    ref = drive(build(cfg, params, None, **kw), prompts)
+    got = drive(build(cfg, params, tp_mesh(2), **kw), prompts)
+    assert got == ref
+
+
+def test_sharded_byte_identity_tp4(tiny):
+    cfg, params = tiny
+    prompts = mixed_prompts(cfg, seed=3)
+    ref = drive(build(cfg, params, None, kv_dtype="int8"), prompts)
+    got = drive(build(cfg, params, tp_mesh(4), kv_dtype="int8"), prompts)
+    assert got == ref
+
+
+def test_per_chip_pool_bytes_scale(tiny):
+    cfg, params = tiny
+    b1 = build(cfg, params, None,
+               kv_dtype="int8").pool_metrics()["kv_pool_device_bytes"]
+    for tp in (2, 4):
+        pm = build(cfg, params, tp_mesh(tp), kv_dtype="int8").pool_metrics()
+        assert pm["tp"] == tp
+        assert pm["kv_pool_device_bytes"] * tp == b1
+
+
+def test_sharded_steady_state_zero_retrace_varying_tables(
+        tiny, recompile_guard):
+    """Steady-state decode on the mesh: block tables vary in CONTENT
+    across waves (fresh admissions on recycled pages), lens/last flip
+    between host writes and island outputs — ONE compiled program, with
+    pool + scales + table donated through the island."""
+    cfg, params = tiny
+    eng = build(cfg, params, tp_mesh(2), kv_dtype="int8")
+    rng = np.random.default_rng(0)
+    for n in (5, 6):                                   # warmup: both table keys
+        eng.submit(rng.integers(0, cfg.vocab, n), max_new=3)
+        eng.run()
+    recompile_guard.track("decode", eng._decode)
+    recompile_guard.track("prefill", eng._prefill)
+    recompile_guard.snapshot()
+    for n in (4, 6, 8):
+        eng.submit(rng.integers(0, cfg.vocab, n), max_new=3)
+        eng.submit(rng.integers(0, cfg.vocab, n - 1), max_new=2)
+        eng.run()
+    # teardown asserts zero misses
+
+
+def test_sharded_donation_through_island(tiny):
+    import jax.numpy as jnp
+
+    from k8s_gpu_scheduler_tpu.analysis.recompile import check_donation
+
+    cfg, params = tiny
+    eng = build(cfg, params, tp_mesh(2), kv_dtype="int8")
+    args = (params, eng._k, eng._v, eng._ks, eng._vs,
+            jnp.asarray(eng._table_np), eng._lens, eng._last,
+            np.asarray([True, True]), np.int32(1))
+    findings = check_donation(eng._decode, *args, donated=(1, 2, 3, 4, 5),
+                              name="decode_tp")
+    assert findings == []
+
+
+def test_entrypoints_scenario_registered():
+    from k8s_gpu_scheduler_tpu.analysis import entrypoints as eps
+    from k8s_gpu_scheduler_tpu.analysis.recompile import audit_steady_state
+
+    scenarios = dict(eps.recompile_scenarios())
+    assert "batcher_steady_decode_paged_tp" in scenarios
+    findings = audit_steady_state(
+        scenarios["batcher_steady_decode_paged_tp"],
+        "batcher_steady_decode_paged_tp")
+    assert findings == []
+
+
+# -- snapshot portability across mesh shapes ----------------------------------
+
+def test_snapshot_round_trip_tp2_tp1_tp4(tiny):
+    """drain on tp=2 → restore on tp=1 (unsharded) → drain → restore on
+    tp=4: every stream finishes byte-identical to an uninterrupted
+    unsharded run — fleet shed/failover across heterogeneous replicas."""
+    cfg, params = tiny
+    prompts = mixed_prompts(cfg, seed=1)
+
+    ref = drive(build(cfg, params, None, kv_dtype="int8",
+                      prefix_cache=True), prompts, max_new=6)
+
+    e2 = build(cfg, params, tp_mesh(2), kv_dtype="int8", prefix_cache=True)
+    for p in prompts:
+        e2.submit(p, max_new=6)
+    done = {}
+    done.update(e2.step())
+    snap = e2.drain()
+
+    e1 = build(cfg, params, None, kv_dtype="int8", prefix_cache=True)
+    e1.restore(snap)
+    done.update(e1.step())
+    snap2 = e1.drain()
+
+    e4 = build(cfg, params, tp_mesh(4), kv_dtype="int8", prefix_cache=True)
+    e4.restore(snap2)
+    while e4.pending:
+        done.update(e4.step())
+    assert done == ref
+
+
+def test_partial_shed_absorb_across_tp(tiny):
+    """Partial drain (load shedding) from a tp=2 replica absorbs into an
+    unsharded one and the migrated stream stays byte-identical."""
+    cfg, params = tiny
+    rng = np.random.default_rng(2)
+    prompts = [list(rng.integers(0, cfg.vocab, n)) for n in (6, 9)]
+    ref = drive(build(cfg, params, None, kv_dtype="int8"), prompts,
+                max_new=6)
+
+    src = build(cfg, params, tp_mesh(2), kv_dtype="int8")
+    rids = [src.submit(p, max_new=6) for p in prompts]
+    done = {}
+    done.update(src.step())
+    shed_slot = src.active_slot_ids()[0]
+    shed_rid = src._slot_req[shed_slot]
+    snap = src.drain(slots=[shed_slot])
+    assert snap.partial
+
+    tgt = build(cfg, params, None, kv_dtype="int8")
+    mapping = tgt.absorb(snap)
+    while src.pending:
+        done.update(src.step())
+    migrated = {}
+    while tgt.pending:
+        migrated.update(tgt.step())
+    done[shed_rid] = done.get(shed_rid, []) + migrated[mapping[shed_rid]]
+    assert done == ref
+
+
+def test_fingerprint_mesh_agnostic(tiny):
+    cfg, params = tiny
+    fp1 = build(cfg, params, None, kv_dtype="int8").fingerprint()
+    fp2 = build(cfg, params, tp_mesh(2), kv_dtype="int8").fingerprint()
+    assert fp1 == fp2
+
+
+# -- validation + fallback gate -----------------------------------------------
+
+def test_mesh_without_tp_axis_rejected(tiny):
+    cfg, params = tiny
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[:2]), ("dp",))
+    with pytest.raises(ValueError, match="tp"):
+        build(cfg, params, mesh)
+
+
+def test_kv_heads_not_divisible_rejected(tiny):
+    cfg, params = tiny
+    cfg3 = dataclasses.replace(cfg, n_heads=6, n_kv_heads=3,
+                               d_model=48)
+    params3 = init_params(cfg3, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="divisible"):
+        build(cfg3, params3, tp_mesh(2))
+
+
+def test_paged_mesh_no_longer_rejected(tiny):
+    """The PR-3 gate (NotImplementedError: paged requires mesh=None) is
+    gone — a mesh-built paged engine serves, fused, with no fallback
+    counted."""
+    cfg, params = tiny
+    serving.reset_decode_fallback_counts()
+    eng = build(cfg, params, tp_mesh(2))
+    eng.submit([1, 2, 3, 4], max_new=2)
+    out = eng.run()
+    assert len(out) == 1
+    assert "mesh_contiguous" not in serving.decode_fallback_counts()
+    assert "mesh_constrained_cache" not in serving.decode_fallback_counts()
+
+
+def test_contiguous_mesh_fallback_warns_once_and_counts(tiny):
+    """The old silent downgrade at the contiguous/static paths is now an
+    explicit, warn-once, metric-counted gate."""
+    from k8s_gpu_scheduler_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    cfg, params = tiny
+    serving.reset_decode_fallback_counts()
+    mesh = make_mesh(MeshSpec.for_devices(2, tp=2))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=32,
+                                chunk=2, prefill_bucket=8, mesh=mesh)
+        eng.submit([1, 2, 3, 4], max_new=2)
+        eng.run()
+    counts = serving.decode_fallback_counts()
+    assert counts.get("mesh_contiguous", 0) >= 1
+    hits = [w for w in caught if "downgraded to the dense path"
+            in str(w.message)]
+    assert len(hits) == 1                    # warn ONCE per reason
+
+
+def test_fallback_counter_exported():
+    from k8s_gpu_scheduler_tpu.metrics.exporter import (
+        DECODE_FALLBACK_TOTAL, Registry, export_decode_fallbacks)
+
+    reg = Registry()
+    export_decode_fallbacks(reg, {"mesh_contiguous": 2})
+    export_decode_fallbacks(reg, {"mesh_contiguous": 3})   # delta-inc
+    c = reg.counter(DECODE_FALLBACK_TOTAL)
+    assert c.value(reason="mesh_contiguous") == 3.0
+    assert 'tpu_serve_decode_fallback_total{reason="mesh_contiguous"} 3.0' \
+        in reg.expose()
+    # A SOURCE reset (serving.reset_decode_fallback_counts) re-bases the
+    # watermark: downgrades after the reset must still export instead of
+    # hiding below the old high-water mark.
+    export_decode_fallbacks(reg, {"mesh_contiguous": 1})
+    assert c.value(reason="mesh_contiguous") == 4.0
+
+
+def test_replica_summary_carries_tp(tiny):
+    from k8s_gpu_scheduler_tpu.fleet.summary import ReplicaSummary, summarize
+
+    cfg, params = tiny
+    eng = build(cfg, params, tp_mesh(2))
+    assert eng.replica_stats()["tp"] == 2
+    s = summarize(eng, "r0")
+    assert s.tp == 2
+    assert ReplicaSummary.from_json(s.to_json()).tp == 2
+
+
+# -- GSPMD audit ---------------------------------------------------------------
+
+def test_gspmd_pass_tree_clean():
+    from k8s_gpu_scheduler_tpu.analysis import run_gspmd_pass
+
+    report = run_gspmd_pass()
+    assert not report.findings, "\n" + report.render(
+        header="gspmd regressions:")
+
+
+def test_gspmd_fixture_caught():
+    fixture = os.path.join(os.path.dirname(__file__), "data",
+                           "graftcheck", "bad_gspmd.py")
+    from k8s_gpu_scheduler_tpu.analysis import run_gspmd_pass
+
+    report = run_gspmd_pass([fixture])
+    rules = {f.rule for f in report.findings}
+    assert {"cache-spec-mismatch", "oversized-replicated",
+            "unconstrained-scan-carry"} <= rules, rules
+    assert report.errors                     # fails the CLI
+
+
+def test_gspmd_flags_wrong_island_mapping(tiny):
+    """A hand-built island whose pool maps the PAGE dim instead of the
+    kv-heads dim is flagged — the audit reads shard_map in_names, not
+    intent."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from k8s_gpu_scheduler_tpu.analysis.gspmd import audit_sharded_callable
+    from k8s_gpu_scheduler_tpu.parallel.sharding import shard_map
+
+    mesh = tp_mesh(2)
+    bad = shard_map(lambda pool: pool, mesh=mesh,
+                    in_specs=(P(None, "tp"),), out_specs=P(None, "tp"),
+                    check_vma=False)
+    pool = jnp.zeros((2, 4, 8, 8, 8), jnp.bfloat16)
+    findings = audit_sharded_callable(bad, (pool,), "bad_island",
+                                      pool_spec=True)
+    assert any(f.rule == "island-pool-spec" for f in findings), findings
