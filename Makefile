@@ -48,6 +48,7 @@ bench-smoke:
 	$(PY) bench.py --leg fleet_chaos --smoke
 	$(PY) bench.py --leg chunked_prefill --smoke
 	$(PY) bench.py --leg sharded_decode --smoke
+	$(PY) bench.py --leg multiturn --smoke
 	$(PY) bench.py --leg decode_attention --smoke
 
 demo: native

@@ -2083,6 +2083,156 @@ def bench_chunked_prefill(smoke=False):
     }
 
 
+def bench_multiturn(smoke=False):
+    """Multi-turn serving leg — the prefix-attention prefill kernel +
+    decoded-suffix caching, measured end-to-end: N conversations × K
+    turns (each turn's prompt IS the whole prior transcript + new user
+    text) driven over the SAME trace through four engine configs —
+    kernel-on/donation-on (the feature), gather/donation-on (the
+    kernel A/B: same reuse, materializing prefix attention),
+    kernel-on/donation-off (the reuse A/B: PR 4's prompt-only
+    donation), and a warm pass of the feature config under a
+    RecompileGuard. Greedy streams must be identical across all
+    configs (the trace is then genuinely shared), the measured pass
+    must be zero-retrace (hit lengths/tables/donated content vary,
+    the compiled (tb, hb) rungs must not), turn-2+ prefill tokens
+    skipped with donation on must strictly beat the prompt-only
+    baseline (each turn re-prefilling its own previous answer is
+    exactly the waste the donation removes), and the warmed-cache
+    turn-2+ TTFT p50 must be strictly lower. On CPU (or --smoke) the
+    model is tiny/f32 with the kernel interpreted; the TPU run under
+    the driver is what BENCH_*.json captures."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_scheduler_tpu.analysis.recompile import RecompileGuard
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import (
+        ContinuousBatcher, decode_fallback_counts,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if smoke or not on_tpu:
+        # f32: the identity assert must see no bf16 near-tie noise.
+        cfg = dataclasses.replace(LlamaConfig.tiny(),
+                                  decode_attn="fused", dtype=jnp.float32)
+        n_conv, n_turns, p1_len, user_len, turn_new = 4, 3, 16, 8, 24
+        eng_kw = dict(n_slots=4, max_len=128, chunk=4, prefill_bucket=8,
+                      page_size=8)
+    else:
+        cfg = LlamaConfig(
+            vocab=32000, d_model=1024, n_layers=4, n_heads=16,
+            n_kv_heads=16, d_ff=4096, max_seq=4096, remat=False,
+            decode_attn="fused")
+        n_conv, n_turns, p1_len, user_len, turn_new = 8, 4, 192, 64, 128
+        eng_kw = dict(n_slots=8, max_len=4096, chunk=16,
+                      prefill_bucket=128, page_size=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def user_text(rng, turn):
+        return list(rng.integers(0, cfg.vocab,
+                                 p1_len if turn == 0 else user_len))
+
+    def drive(prefill_attn, donate, guard=None):
+        """All N conversations advance turn-by-turn (turn k of every
+        conversation batches together). Returns (replies per conv,
+        engine, wall seconds, turn-2+ request metrics)."""
+        eng = ContinuousBatcher(params, cfg, kv_dtype="int8",
+                                kv_layout="paged", prefix_cache=True,
+                                prefill_attn=prefill_attn,
+                                donate_decoded=donate, **eng_kw)
+        # Warm pass: ONE extra conversation walks every turn's (tb, hb)
+        # rung end-to-end, outside the measured window.
+        wrng = np.random.default_rng(99)
+        transcript = []
+        for turn in range(n_turns):
+            prompt = transcript + user_text(wrng, turn)
+            eng.submit(prompt, max_new=turn_new)
+            done = {}
+            while eng.pending:
+                done.update(eng.step())
+            (_, toks), = done.items()
+            transcript = prompt + toks
+        eng.pop_request_metrics()
+        warm = eng.pool_metrics()
+        if guard is not None:
+            guard.track("decode", eng._decode)
+            guard.track("prefill", eng._prefill)
+            guard.snapshot()
+        rngs = [np.random.default_rng(i) for i in range(n_conv)]
+        transcripts = [[] for _ in range(n_conv)]
+        replies = [[] for _ in range(n_conv)]
+        turn_metrics: dict = {}
+        t0 = time.perf_counter()
+        for turn in range(n_turns):
+            rids = {}
+            for c in range(n_conv):
+                prompt = transcripts[c] + user_text(rngs[c], turn)
+                rids[eng.submit(prompt, max_new=turn_new)] = (c, prompt)
+            done = {}
+            while eng.pending:
+                done.update(eng.step())
+            for rid, (c, prompt) in rids.items():
+                replies[c].append(done[rid])
+                transcripts[c] = prompt + done[rid]
+            if turn >= 1:
+                turn_metrics.update(eng.pop_request_metrics())
+            else:
+                eng.pop_request_metrics()
+        wall = time.perf_counter() - t0
+        eng._alloc.assert_consistent()
+        return replies, eng, warm, wall, turn_metrics
+
+    guard = RecompileGuard()
+    rep_on, eng_on, warm_on, wall_on, met_on = drive("kernel", True, guard)
+    retraces = sum(guard.misses_since().values())
+    rep_ga, _, _, wall_ga, _ = drive("gather", True)
+    rep_off, eng_off, warm_off, wall_off, met_off = drive("kernel", False)
+    identity = rep_on == rep_ga == rep_off
+
+    m_on, m_off = eng_on.pool_metrics(), eng_off.pool_metrics()
+    skipped_on = m_on["prefill_tokens_skipped"] \
+        - warm_on["prefill_tokens_skipped"]
+    skipped_off = m_off["prefill_tokens_skipped"] \
+        - warm_off["prefill_tokens_skipped"]
+    # Per-conversation reuse floor: turn 2 must mount at least turn 1's
+    # prompt + decoded full pages (the acceptance criterion's bound).
+    ps = eng_kw["page_size"]
+    turn1_conv = p1_len + turn_new - 1
+    floor = n_conv * ((turn1_conv // ps) * ps)
+    total_tokens = n_conv * n_turns * turn_new
+    extra = {
+        "multiturn_shape": f"{n_conv} convs x {n_turns} turns "
+                           f"(p1 {p1_len} + user {user_len}, "
+                           f"{turn_new} new/turn)",
+        "multiturn_interpret": not on_tpu,
+        "multiturn_token_identity": bool(identity),
+        "multiturn_retraces": int(retraces),
+        "multiturn_tokens_skipped": skipped_on,
+        "multiturn_tokens_skipped_prompt_only": skipped_off,
+        "multiturn_skip_floor": floor,
+        "multiturn_decoded_pages_donated":
+            m_on["decoded_pages_donated_total"],
+        "multiturn_tok_s_kernel": round(total_tokens / wall_on, 1),
+        "multiturn_tok_s_gather": round(total_tokens / wall_ga, 1),
+        "multiturn_tok_s_prompt_only": round(total_tokens / wall_off, 1),
+        "multiturn_fallbacks": int(sum(
+            decode_fallback_counts().values())),
+    }
+    extra.update(_latency_stats(met_on, prefix="multiturn_warm_"))
+    extra.update(_latency_stats(met_off, prefix="multiturn_prompt_only_"))
+    return {
+        "metric": "multiturn_bench",
+        "value": extra["multiturn_warm_ttft_p50_ms"],
+        "unit": "ms_warm_ttft_p50",
+        "extra": extra,
+    }
+
+
 def bench_sharded_decode(smoke=False, tp=2):
     """Multi-chip sharded paged serving (shard_map islands over tp) on
     FORCED host devices: the same open-loop workload through an
@@ -2234,11 +2384,14 @@ def main(argv=None):
         if leg == "sharded_decode":
             print(json.dumps(bench_sharded_decode(smoke="--smoke" in args)))
             return
+        if leg == "multiturn":
+            print(json.dumps(bench_multiturn(smoke="--smoke" in args)))
+            return
         raise SystemExit(f"unknown bench leg: {leg!r} (available: "
                          f"decode_attention, paged_attention, prefix_cache, "
                          f"speculative, analysis, chaos, obs_overhead, "
                          f"fleet, fleet_chaos, chunked_prefill, "
-                         f"sharded_decode)")
+                         f"sharded_decode, multiturn)")
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
     # the bench measures the scheduler as deployed.
     sys.setswitchinterval(0.001)

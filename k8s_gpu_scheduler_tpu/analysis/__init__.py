@@ -56,7 +56,7 @@ from .tracelint import lint_trace_calls
 from .vmem import (
     VMEM_BYTES_PER_CORE, audit_vmem, decode_attention_footprint,
     flash_attention_footprint, paged_decode_attention_footprint,
-    paged_verify_attention_footprint,
+    paged_prefill_attention_footprint, paged_verify_attention_footprint,
 )
 
 __all__ = [
@@ -73,6 +73,7 @@ __all__ = [
     "decode_attention_footprint",
     "flash_attention_footprint",
     "paged_decode_attention_footprint",
+    "paged_prefill_attention_footprint",
     "paged_verify_attention_footprint",
     "audit_shared_pages",
     "check_shared_pages",

@@ -165,6 +165,18 @@ def jaxpr_entrypoints() -> List[Tuple[str, Callable, tuple]]:
     entries.append(("paged_verify_attention",
                     partial(paged_verify_attention, interpret=True),
                     (qv, pool, pool, table, jnp.full((2,), 9, jnp.int32))))
+
+    # Prefix-attention prefill over the same pool (tb = 16 tail rows per
+    # slot, two-regime mask: cached prefix pages through the table, the
+    # tail's own dense K/V causal — the hb>0 tail-prefill kernel).
+    from ..ops.decode_attention import paged_prefill_attention
+
+    qp = jnp.zeros((2, 16, 8, 8), jnp.bfloat16)
+    tailkv = jnp.zeros((2, 16, 8, 8), jnp.bfloat16)
+    entries.append(("paged_prefill_attention",
+                    partial(paged_prefill_attention, interpret=True),
+                    (qp, pool, pool, table[:, :2],
+                     jnp.full((2,), 16, jnp.int32), tailkv, tailkv)))
     return entries
 
 
@@ -235,6 +247,16 @@ def gspmd_entrypoints() -> List[Tuple[str, Callable, tuple, dict]]:
         (eng.params, eng._k, eng._v, eng._ks, eng._vs, eng._lens,
          eng._last, slots, pids, np.zeros((2, 0), np.int32),
          np.zeros((2,), np.int32), tokens8, lens, np.int32(1)),
+        {"pool_spec": True}))
+    # Prefix tail-prefill rung (hb=1) inside the island: the Pallas
+    # prefix-attention kernel runs per shard on its local head family
+    # with the pool operands mapped per POOL_SPEC — the same
+    # expectations as the plain prefill entry.
+    entries.append((
+        "batcher_prefill_paged_prefix_tp", eng._prefill,
+        (eng.params, eng._k, eng._v, eng._ks, eng._vs, eng._lens,
+         eng._last, slots, pids, np.full((2, 1), 2, np.int32),
+         np.full((2,), 8, np.int32), tokens8, lens, np.int32(1)),
         {"pool_spec": True}))
     seng = _sharded_tiny_engine(speculative=True)
     entries.append((
@@ -390,6 +412,54 @@ def _paged_prefix_batcher_scenario() -> tuple:
     return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
 
 
+def _prefix_kernel_multiturn_scenario() -> tuple:
+    """Multi-turn edition of the prefix scenario, Pallas-kernel prefill:
+    every steady wave is a TWO-TURN conversation — turn 1 reaps and
+    donates its prompt AND decoded pages into the radix tree
+    (donate_decoded), turn 2 re-submits the full transcript plus new
+    user text and mounts it as a cached prefix, dispatching the hb>0
+    tail-prefill rung whose body is now ops.paged_prefill_attention
+    (decode_attn='fused'). By design still one compiled program per
+    (tb, hb) rung across waves — hit lengths, prefix tables and tail
+    tokens vary in CONTENT only, the donated decoded pages just deepen
+    the tree — and the pool keeps riding the donation chain. The
+    step()-driven loop flushes per step, so the decoded-suffix donation
+    path (host mirror at reap) is actually exercised."""
+    import dataclasses
+
+    from ..models.serving import ContinuousBatcher
+
+    cfg, params = _tiny()
+    eng = ContinuousBatcher(params, dataclasses.replace(cfg,
+                                                        decode_attn="fused"),
+                            n_slots=2, max_len=64, chunk=2,
+                            prefill_bucket=8, kv_dtype="int8",
+                            kv_layout="paged", page_size=8,
+                            prefix_cache=True)
+    rng = np.random.default_rng(0)
+
+    def conversation(seed_row: int):
+        # Fixed lengths every wave → fixed (tb, hb) rungs: turn-1 prompt
+        # 16 tokens (tb=16, hb=0), 12 decoded; turn-2 = transcript + 4
+        # new tokens = 32, of which 3 pages mount (tb=8, hb=4 rung).
+        p1 = list(rng.integers(0, cfg.vocab, 16))
+        eng.submit(p1, max_new=12)
+        done = {}
+        while eng.pending:
+            done.update(eng.step())
+        (rid, toks), = done.items()
+        eng.submit(p1 + toks + list(rng.integers(0, cfg.vocab, 4)),
+                   max_new=4)
+        while eng.pending:
+            eng.step()
+
+    def warmup():
+        conversation(0)
+
+    steady = [lambda i=i: conversation(i) for i in (1, 2, 3)]
+    return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
+
+
 def _paged_chunked_batcher_scenario() -> tuple:
     """Chunked-prefill edition of the paged scenario: a long prompt's
     budgeted prefill CHUNKS interleave with live decode traffic across
@@ -539,6 +609,7 @@ def recompile_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
         ("batcher_steady_decode_paged_spec", _paged_spec_batcher_scenario),
         ("batcher_steady_mixed_chunked", _paged_chunked_batcher_scenario),
         ("batcher_steady_decode_paged_tp", _sharded_paged_batcher_scenario),
+        ("batcher_steady_prefix_kernel", _prefix_kernel_multiturn_scenario),
         ("generate_steady_state", _generate_scenario),
     ]
 
@@ -688,6 +759,67 @@ def _alias_prefill_scenario() -> tuple:
     return eng._prefill, args, (1, 2, 3, 4), (0, 1, 2, 3), shared
 
 
+def _prefix_engine_decoded():
+    """A warmed prefix-cache engine whose radix tree holds a DECODED-
+    suffix page (turn-1 of a conversation reaped with donate_decoded),
+    with a live turn-2 request MOUNTING the whole transcript — prompt
+    pages AND the decoded page — mid-decode. The state the multi-turn
+    alias scenario audits against. Returns (engine, shared page ids)."""
+    import dataclasses
+
+    from ..models.serving import ContinuousBatcher
+
+    cfg, params = _tiny()
+    eng = ContinuousBatcher(params, dataclasses.replace(cfg,
+                                                        decode_attn="fused"),
+                            n_slots=2, max_len=64, chunk=2,
+                            prefill_bucket=8, kv_dtype="int8",
+                            kv_layout="paged", page_size=8,
+                            prefix_cache=True)
+    rng = np.random.default_rng(0)
+    p1 = list(rng.integers(0, cfg.vocab, 16))
+    eng.submit(p1, max_new=12)                   # turn 1
+    done: dict = {}
+    while eng.pending:
+        done.update(eng.step())
+    (_, toks), = done.items()
+    decoded = float(eng.pool_metrics()["decoded_pages_donated_total"])
+    assert decoded >= 1, "scenario must actually donate a decoded page"
+    # Turn 2 mounts prompt + decoded pages, then decodes on top of them.
+    eng.submit(p1 + toks + list(rng.integers(0, cfg.vocab, 4)), max_new=9)
+    eng.step()
+    shared = sorted({p for pages in eng._slot_shared.values()
+                     for p in pages})
+    assert len(shared) >= 3, "turn 2 must mount prompt AND decoded pages"
+    return eng, shared
+
+
+def _alias_prefill_kernel_scenario() -> tuple:
+    """The Pallas prefix-attention tail-prefill dispatch with a mounted
+    shared prefix that INCLUDES a decoded-suffix page: the kernel
+    streams those pages read-only through the table indirection and the
+    page-granular scatter must touch only the entry's OWN pages — the
+    copy-on-write proof for both halves of the multi-turn feature (the
+    kernel body and the decoded donation) in one dispatch."""
+    from ..models.paging import NULL_PAGE
+
+    eng, shared = _prefix_engine_decoded()
+    own = eng._alloc.alloc(1)        # a throwaway tail page to scatter to
+    eng._alloc.retain(shared)        # mirror admission's mount
+    rng = np.random.default_rng(1)
+    hb = 4                           # _hb_bucket(3) — the real turn-2 rung
+    prow = [shared[j] if j < len(shared) else NULL_PAGE for j in range(hb)]
+    args = (eng.params, eng._k, eng._v, eng._ks, eng._vs, eng._lens,
+            eng._last, np.ones((2,), np.int32),
+            np.full((2, 1), own[0], np.int32),
+            np.asarray([prow] * 2, np.int32),
+            np.full((2,), len(shared) * 8, np.int32),
+            np.asarray([list(rng.integers(0, 256, 8))] * 2, np.int32),
+            np.full((2,), 4, np.int32), np.int32(99))
+    # _prefill returns (k, v, k_s, v_s, lens, last, firsts).
+    return eng._prefill, args, (1, 2, 3, 4), (0, 1, 2, 3), shared
+
+
 def _alias_decode_scenario() -> tuple:
     """A decode chunk over a block table whose prefix rows are shared:
     the per-slot scatter at ``lens`` must land past the mounted prefix,
@@ -724,6 +856,7 @@ def alias_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
     every real program that runs with aliased prefix pages in its pool."""
     return [
         ("batcher_prefill_paged_prefix", _alias_prefill_scenario),
+        ("batcher_prefill_prefix_kernel", _alias_prefill_kernel_scenario),
         ("batcher_decode_paged_prefix", _alias_decode_scenario),
         ("batcher_verify_paged_prefix", _alias_verify_scenario),
     ]

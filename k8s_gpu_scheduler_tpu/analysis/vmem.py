@@ -177,6 +177,41 @@ def paged_verify_attention_footprint(
     )
 
 
+def paged_prefill_attention_footprint(
+    page_size: int, g: int, hd: int, hb: int, tb: int, batch: int = 8,
+    kv_dtype: str = "bfloat16", quant: bool = False,
+    q_dtype: str = "bfloat16",
+) -> KernelFootprint:
+    """Working set of ops/decode_attention._prefill_kernel for one grid
+    program — the prefix-attention tail-prefill window (the hb>0 rung of
+    the serving engine's prefix-cache prefill). The kv side is the paged
+    picture (the page is the kv block, double-buffered, int8 scale
+    planes in quant mode, a [B, hb] prefix-table scalar working set)
+    PLUS the tail's own K/V riding as a second double-buffered
+    exact-dtype page block pair; the Q-WINDOW ROWS MULTIPLY the
+    query/output/scratch side by tb·g — the verify kernel's t·g blowup
+    at t = the whole tail bucket. That factor is how a long prefill rung
+    walks the kernel over the budget while the kv traffic looks
+    unchanged — the runtime gate is ops.prefill_plan's
+    PREFILL_MAX_Q_ROWS cap (rungs past it fall back to the dense
+    gather, counted); this estimator is the precise per-preset check
+    that the cap actually holds under the 16 MiB budget."""
+    rows = tb * g
+    in_blocks, out_blocks, scratch = _paged_kv_working_set(
+        rows, page_size, hd, hb, batch, kv_dtype, quant, q_dtype)
+    # The tail K/V pair: [1, 1, ps, 1, hd] blocks in the compute dtype
+    # (these rows are computed by the dispatch — never quantized on the
+    # way in), double-buffered like every grid-streamed input.
+    in_blocks += 2 * _nbytes((1, 1, page_size, 1, hd), q_dtype)
+    return KernelFootprint(
+        name=f"paged_prefill(ps={page_size}, hb={hb}, tb={tb}, g={g}, "
+             f"hd={hd}, kv={'int8' if quant else kv_dtype})",
+        in_blocks=in_blocks, out_blocks=out_blocks, scratch=scratch,
+        notes=f"page_size={page_size}, tb*g={rows} q-window rows multiply "
+              f"the q/out/scratch set + dense tail K/V blocks",
+    )
+
+
 def flash_attention_footprint(
     block_q: int, block_k: int, d: int, dtype: str = "bfloat16",
     with_lse: bool = True, backward: bool = False,
@@ -240,7 +275,7 @@ def audit_vmem(budget: int = VMEM_BYTES_PER_CORE) -> List[Finding]:
     block-table scalar footprint), training flash fwd+bwd at each
     preset's max_seq."""
     from ..ops.decode_attention import (
-        DEFAULT_PAGE_SIZE, decode_plan, paged_plan,
+        DEFAULT_PAGE_SIZE, decode_plan, paged_plan, prefill_plan,
     )
     from ..ops.flash_attention import _shrink_to_divisor
 
@@ -289,6 +324,25 @@ def audit_vmem(budget: int = VMEM_BYTES_PER_CORE) -> List[Finding]:
                             ps, g, cfg.head_dim, s // ps, 1 + gamma,
                             quant=quant)
                         findings.extend(fp.check(budget, anchor=anchor))
+                    # Prefix-attention tail prefill: every (tb) rung of
+                    # the engine's page-quantized bucket ladder the
+                    # runtime plan ACCEPTS must fit — rungs past the
+                    # PREFILL_MAX_Q_ROWS cap fall back to the dense
+                    # gather by design and are exempt (a cap the plan
+                    # accepts but the budget rejects is exactly the
+                    # cliff this audit exists to catch). hb is taken at
+                    # the worst case: the rest of the cache as cached
+                    # prefix.
+                    tb = ps
+                    while tb <= s:
+                        hb = max((s - tb) // ps, 1)
+                        if prefill_plan(hb + tb // ps, ps,
+                                        tb * g) is not None:
+                            fp = paged_prefill_attention_footprint(
+                                ps, g, cfg.head_dim, hb, tb, quant=quant)
+                            findings.extend(
+                                fp.check(budget, anchor=anchor))
+                        tb *= 2
         # Training flash attention at max_seq (forward defaults 256/512;
         # backward shrinks to <=256 divisors — mirror _resolve/_bwd).
         t = cfg.max_seq

@@ -232,12 +232,22 @@ SERVING_POOL_GAUGES = {
     "prefix_cached_pages": "pages (= radix-tree nodes) in the prefix cache",
     "prefix_hit_rate": "token-weighted prefix-cache hit rate",
     "prefix_request_hit_rate": "fraction of lookups matching any prefix",
-    "prefix_hit_tokens": "cumulative prompt tokens served from the cache",
+    # NOTE: the pool_metrics key "prefix_hit_tokens" (cumulative) stays
+    # available to host-side consumers (bench, fleet router), but its
+    # Prometheus surface is now the tpu_serve_prefix_hit_tokens
+    # HISTOGRAM below — per-admission hit lengths, whose _sum series IS
+    # the old cumulative gauge and whose buckets show the distribution
+    # (8-token system prompts vs whole mounted conversations).
     "prefix_lookup_tokens": "cumulative prompt tokens looked up",
     "prefix_lookups": "cumulative prefix-cache lookups (admissions)",
     "prefix_lookup_hits": "cumulative lookups that matched any prefix",
     "prefix_inserted_pages": "cumulative pages adopted into the tree",
     "prefix_evictions": "cumulative prefix-cache pages evicted (LRU)",
+    # Decoded-suffix donations (multi-turn serving): adopted pages whose
+    # token chunk extends past the donor's prompt — the reuse that lets
+    # turn N+1 of a conversation mount turn N's whole transcript.
+    "decoded_pages_donated_total":
+        "decoded-suffix pages donated into the prefix tree at reap",
     "prefill_tokens_skipped": "prefill rows skipped via prefix reuse",
     # Chunked prefill (serving.ContinuousBatcher prefill_chunk_tokens):
     # backlog = admitted-but-unfinished prefill tokens (the fleet
@@ -285,6 +295,17 @@ PHASE_HISTOGRAM = "tpu_serve_phase_duration_seconds"
 PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
 
+# Per-admission prefix-cache hit lengths (tokens), fed from the
+# ``prefix_hit_token_batch`` pool_metrics() drains in the same lock
+# snapshot as the phase batch. Power-of-two token buckets spanning one
+# page to whole mounted conversations; the 0-observations (misses) land
+# below the first bucket, so hit-given-lookup is readable off the le=8
+# edge. The _sum series is the cumulative hit-token count the old gauge
+# carried.
+PREFIX_HIT_HISTOGRAM = "tpu_serve_prefix_hit_tokens"
+PREFIX_HIT_BUCKETS = (8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+                      1024.0, 2048.0, 4096.0, 8192.0)
+
 
 def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
                         prefix: str = "tpu_serve_",
@@ -322,6 +343,15 @@ def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
             buckets=PHASE_BUCKETS)
         for phase, seconds in phases:
             hist.observe(float(seconds), phase=str(phase), **labels)
+    hits = pool_metrics.get("prefix_hit_token_batch") or ()
+    if hits:
+        hist = registry.histogram(
+            PREFIX_HIT_HISTOGRAM,
+            "Prefix-cache hit length per admission, in prompt tokens "
+            "(0 = miss; whole mounted conversations land in the tail)",
+            buckets=PREFIX_HIT_BUCKETS)
+        for tokens in hits:
+            hist.observe(float(tokens), **labels)
 
 
 # Decode fused→dense downgrade visibility (models/serving.py

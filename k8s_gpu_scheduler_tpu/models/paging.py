@@ -12,8 +12,10 @@ entrypoint publish.
 
 Since the prefix cache landed (models/prefix_cache.py) pages are
 REF-COUNTED: one physical page can back the block tables of many slots
-at once (a shared system-prompt prefix) plus a reference held by the
-radix tree itself. ``alloc`` hands out pages at refcount 1, ``retain``
+at once (a shared system-prompt prefix — or, since the decoded-suffix
+donation, a whole previous conversation turn: reaped requests donate
+their prompt AND resident decoded pages, so multi-turn follow-ups mount
+the entire transcript) plus a reference held by the radix tree itself. ``alloc`` hands out pages at refcount 1, ``retain``
 adds a holder, ``free`` drops one — a page returns to the free list only
 when its LAST reference drops. The tree's reference is labeled via
 ``adopt``/``drop_cached`` so the pool partitions cleanly into

@@ -28,10 +28,18 @@ The contract with the pool (models/paging.py) is reference counting:
   on it.
 
 Insertion is donation, not copying: when a request is reaped, the pages
-covering its FULL prompt chunks transfer into the tree where the path
-does not exist yet (the slot's reference is re-labeled as the tree's),
-and duplicate chunks — the hit path it was mounted on, or a path a
-concurrent request donated first — stay with the caller to release.
+covering its FULL conversation chunks — prompt AND (since the
+decoded-suffix donation landed in the serving engine) the decoded
+tokens whose KV rows are resident — transfer into the tree where the
+path does not exist yet (the slot's reference is re-labeled as the
+tree's), and duplicate chunks — the hit path it was mounted on, or a
+path a concurrent request donated first — stay with the caller to
+release. Donating decoded pages is what closes the multi-turn loop:
+turn N+1's prompt IS turn N's transcript plus the new user text, so
+the whole conversation mounts as a cached prefix and only the novel
+turn prefills (``prompt_len`` tells ``insert`` where the decoded
+suffix starts, for the donation metrics only — the tree itself is
+oblivious to the split).
 """
 from __future__ import annotations
 
@@ -74,6 +82,7 @@ class PrefixCache:
         self._lookup_tokens = 0              # prompt tokens seen by match()
         self._hit_tokens = 0                 # tokens covered by matches
         self._inserted_pages = 0             # pages adopted into the tree
+        self._decoded_inserted = 0           # ... whose chunk spans decode
         self._evictions = 0                  # pages evicted (LRU)
 
     def __len__(self) -> int:
@@ -121,22 +130,29 @@ class PrefixCache:
         return pages
 
     def insert(self, tokens: Sequence[int],
-               pages: Sequence[int]) -> List[int]:
+               pages: Sequence[int],
+               prompt_len: Optional[int] = None) -> List[int]:
         """Donate ``pages[i]`` as the cached KV of the i-th full chunk of
         ``tokens`` (the reaped request's block-table prefix, shared hit
-        pages included). Returns the pages the tree ADOPTED (their
-        reference now belongs to the tree); every other page — chunks
-        already cached, by this request's own hit path or by a concurrent
-        donor — stays with the caller, which must ``free`` its reference
-        as usual. Raises if ``pages`` is shorter than the chunk walk it
-        must cover."""
+        pages included; since the decoded-suffix donation, ``tokens``
+        may be the whole conversation — prompt + resident decoded
+        suffix). Returns the pages the tree ADOPTED (their reference now
+        belongs to the tree); every other page — chunks already cached,
+        by this request's own hit path or by a concurrent donor — stays
+        with the caller, which must ``free`` its reference as usual.
+        ``prompt_len`` marks where the decoded suffix starts: adopted
+        pages whose chunk extends past it count into the
+        ``decoded_pages_donated_total`` metric (the multi-turn reuse
+        signal — None attributes everything to the prompt, the pre-
+        decoded-donation accounting). Raises if ``pages`` is shorter
+        than the chunk walk it must cover."""
         self._clock += 1
         chunks = self._chunks(tokens)
         if len(pages) < len(chunks):
             raise ValueError(
                 f"{len(chunks)} full chunks but only {len(pages)} pages")
         node, adopted = self._root, []
-        for chunk, page in zip(chunks, pages):
+        for i, (chunk, page) in enumerate(zip(chunks, pages)):
             child = node.children.get(chunk)
             if child is None:
                 self._alloc.adopt([page])
@@ -144,6 +160,9 @@ class PrefixCache:
                 node.children[chunk] = child
                 self._n_nodes += 1
                 self._inserted_pages += 1
+                if prompt_len is not None \
+                        and (i + 1) * self.page_size > prompt_len:
+                    self._decoded_inserted += 1
                 adopted.append(int(page))
             child.last_used = self._clock
             node = child
@@ -250,4 +269,8 @@ class PrefixCache:
                                         if self._lookups else 0.0),
             "prefix_inserted_pages": float(self._inserted_pages),
             "prefix_evictions": float(self._evictions),
+            # Decoded-suffix donations (multi-turn reuse): adopted pages
+            # whose token chunk extends past the donor's prompt — the
+            # pages that let turn N+1 mount turn N's answer.
+            "decoded_pages_donated_total": float(self._decoded_inserted),
         }

@@ -77,7 +77,8 @@ from ..ops.decode_attention import (
     DEFAULT_PAGE_SIZE, contiguous_as_paged, decode_plan,
     dense_decode_reference, dense_verify_reference, flash_decode_attention,
     gather_paged_kv, paged_decode_attention, paged_plan,
-    paged_verify_attention, verify_plan,
+    paged_prefill_attention, paged_verify_attention, prefill_plan,
+    verify_plan,
 )
 from ..ops.layers import apply_rope, rms_norm, rope_freqs
 from ..ops.quant import qdot
@@ -981,7 +982,8 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
                             prefix_tables, hit_lens, tokens, tail_lens,
                             seed, temperature: float = 0.0,
                             top_k: int = 0, k_s=None, v_s=None,
-                            tp_axis=None, tp: int = 1):
+                            tp_axis=None, tp: int = 1,
+                            prefill_attn: str = "auto"):
     """Prefill M freed slots from right-padded prompts [M, tb] in ONE
     dispatch, paged edition: the batched mini cache computes every
     prompt's K/V exactly as the contiguous path, then ONE page-granular
@@ -1059,19 +1061,27 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
         hp = hb * page_size
         g = cfg.n_heads // cfg.n_kv_heads
         scale = 1.0 / (cfg.head_dim ** 0.5)
-
-        def gather_prefix(pool):
-            # [L, n_pages, ps, Hkv, x] -> [L, M, hb*ps, Hkv, x]
-            got = pool[:, prefix_tables]         # [L, M, hb, ps, Hkv, x]
-            return got.reshape(pool.shape[0], M, hp, *pool.shape[3:])
-
-        if quant:
-            pk = (gather_prefix(k).astype(jnp.float32)
-                  * gather_prefix(k_s)).astype(cfg.dtype)
-            pv = (gather_prefix(v).astype(jnp.float32)
-                  * gather_prefix(v_s)).astype(cfg.dtype)
-        else:
-            pk, pv = gather_prefix(k), gather_prefix(v)
+        # Prefix-attention implementation pick (trace-time — once per
+        # compiled (tb, hb) rung, the _note_decode_fallback contract):
+        # "kernel" forces the Pallas path, "gather" forces the dense
+        # materializing path (the parity reference), "auto" follows the
+        # config's decode_attn the way the decode/verify dispatches do.
+        # The kernel streams [prefix pages via the table indirection] ++
+        # [the tail's own K/V] blockwise with NO [L, M, hb·ps, Hkv, hd]
+        # gather and no full-dtype dequant buffer — O(hit+tail) VMEM
+        # traffic where the gather was O(hit_len) HBM materialization
+        # per dispatch, growing with exactly the cache hits the fleet
+        # router optimizes for.
+        want_kernel = prefill_attn == "kernel" or (
+            prefill_attn == "auto"
+            and getattr(cfg, "decode_attn", "dense") == "fused")
+        use_kernel = (want_kernel
+                      and cfg.n_heads % cfg.n_kv_heads == 0
+                      and tb % page_size == 0
+                      and prefill_plan(hb + tb // page_size, page_size,
+                                       tb * g) is not None)
+        if want_kernel and not use_kernel:
+            _note_decode_fallback("no_prefill_plan")
         # Per-entry absolute positions: tail row i sits at hit_len + i
         # (clamped — the bucket's padded tail may overshoot the rope
         # table; those rows are never attended).
@@ -1079,52 +1089,110 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
         angles = rope_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta)[
             jnp.minimum(pos_q, cfg.max_seq - 1)]                # [M,tb,hd/2]
         x = params["embed"][tokens].astype(cfg.dtype)
-        kcol = jnp.arange(hp + tb)[None, None, :]
-        # Prefix col c valid iff c < hit_len; tail col hp+j causal within
-        # the window (query i attends tail rows j <= i).
-        valid = jnp.where(
-            kcol < hp, kcol < hit_lens[:, None, None],
-            (kcol - hp) <= jnp.arange(tb)[None, :, None])       # [M,tb,K]
 
-        def block(x, layer):
-            blk, pk_l, pv_l = layer              # prefix K/V [M, hp, Hkv, hd]
-            h = rms_norm(x, blk["attn_norm"])
-            q = qdot(h, blk["wq"]).reshape(M, tb, cfg.n_heads, cfg.head_dim)
-            kk = qdot(h, blk["wk"]).reshape(M, tb, cfg.n_kv_heads,
-                                            cfg.head_dim)
-            vv = qdot(h, blk["wv"]).reshape(M, tb, cfg.n_kv_heads,
-                                            cfg.head_dim)
-            q, kk = apply_rope(q, angles), apply_rope(kk, angles)
-            if tp_axis is not None:
-                # Island mode: the gathered prefix (pk_l/pv_l) is this
-                # shard's kv-head slice of the pool, so the tail's q/k/v
-                # slice to the matching head family; the scan ys (kk, vv)
-                # stay local — they are exactly the rows this shard's
-                # pool scatter stores.
-                q = _tp_heads(q, tp_axis,
-                              (cfg.n_heads // tp), 2)
-                kk = _tp_heads(kk, tp_axis, hkv_loc, 2)
-                vv = _tp_heads(vv, tp_axis, hkv_loc, 2)
-            h_kv = kk.shape[2]
-            qg = q.reshape(M, tb, h_kv, g, cfg.head_dim)
-            kf = jnp.concatenate([pk_l, kk], axis=1)   # [M, hp+tb, Hkv, hd]
-            vf = jnp.concatenate([pv_l, vv], axis=1)
-            scores = jnp.einsum(
-                "bqhgd,bkhd->bhgqk", qg, kf).astype(jnp.float32) * scale
-            scores = jnp.where(valid[:, None, None], scores, _NEG_INF)
-            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-            attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
-            if tp_axis is not None:
-                # Exact head-axis reassembly ([M, tb, Hkv/tp, g, hd] →
-                # full kv-major head order — movement only).
-                attn = jax.lax.all_gather(attn, tp_axis, axis=2,
-                                          tiled=True)
-            x = x + qdot(attn.reshape(M, tb, cfg.n_heads * cfg.head_dim),
-                         blk["wo"])
-            x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
-            return x, (kk, vv)
+        if use_kernel:
+            def block(x, layer):
+                # Per-layer POOL slices ride as scan xs — a dynamic
+                # slice per layer, never a gathered prefix buffer. In
+                # island mode they are this shard's kv-head slice, so
+                # the kernel runs on its local head family exactly like
+                # the decode/verify dispatches.
+                blk, k_pg, v_pg, ks_p, vs_p = layer
+                h = rms_norm(x, blk["attn_norm"])
+                q = qdot(h, blk["wq"]).reshape(M, tb, cfg.n_heads,
+                                               cfg.head_dim)
+                kk = qdot(h, blk["wk"]).reshape(M, tb, cfg.n_kv_heads,
+                                                cfg.head_dim)
+                vv = qdot(h, blk["wv"]).reshape(M, tb, cfg.n_kv_heads,
+                                                cfg.head_dim)
+                q, kk = apply_rope(q, angles), apply_rope(kk, angles)
+                if tp_axis is not None:
+                    q = _tp_heads(q, tp_axis, (cfg.n_heads // tp), 2)
+                    kk = _tp_heads(kk, tp_axis, hkv_loc, 2)
+                    vv = _tp_heads(vv, tp_axis, hkv_loc, 2)
+                scales = (dict(k_scale=ks_p, v_scale=vs_p)
+                          if quant else {})
+                # Two-regime streamed attention: cached prefix pages
+                # through the table (dequantized in registers — the
+                # SAME bytes decode attends), then the tail's own K/V
+                # (exact dtype, per-row causal) — the gather path's
+                # mask semantics, blockwise.
+                attn = paged_prefill_attention(
+                    q, k_pg, v_pg, prefix_tables, hit_lens, kk, vv,
+                    **scales)
+                if tp_axis is not None:
+                    # Exact head-axis reassembly (movement only).
+                    attn = jax.lax.all_gather(attn, tp_axis, axis=2,
+                                              tiled=True)
+                x = x + qdot(attn.reshape(M, tb,
+                                          cfg.n_heads * cfg.head_dim),
+                             blk["wo"])
+                x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
+                return x, (kk, vv)
 
-        x, (mk, mv) = jax.lax.scan(block, x, (params["blocks"], pk, pv))
+            x, (mk, mv) = jax.lax.scan(
+                block, x, (params["blocks"], k, v, k_s, v_s))
+        else:
+            def gather_prefix(pool):
+                # [L, n_pages, ps, Hkv, x] -> [L, M, hb*ps, Hkv, x]
+                got = pool[:, prefix_tables]     # [L, M, hb, ps, Hkv, x]
+                return got.reshape(pool.shape[0], M, hp, *pool.shape[3:])
+
+            if quant:
+                pk = (gather_prefix(k).astype(jnp.float32)
+                      * gather_prefix(k_s)).astype(cfg.dtype)
+                pv = (gather_prefix(v).astype(jnp.float32)
+                      * gather_prefix(v_s)).astype(cfg.dtype)
+            else:
+                pk, pv = gather_prefix(k), gather_prefix(v)
+            kcol = jnp.arange(hp + tb)[None, None, :]
+            # Prefix col c valid iff c < hit_len; tail col hp+j causal
+            # within the window (query i attends tail rows j <= i).
+            valid = jnp.where(
+                kcol < hp, kcol < hit_lens[:, None, None],
+                (kcol - hp) <= jnp.arange(tb)[None, :, None])   # [M,tb,K]
+
+            def block(x, layer):
+                blk, pk_l, pv_l = layer          # prefix K/V [M, hp, Hkv, hd]
+                h = rms_norm(x, blk["attn_norm"])
+                q = qdot(h, blk["wq"]).reshape(M, tb, cfg.n_heads,
+                                               cfg.head_dim)
+                kk = qdot(h, blk["wk"]).reshape(M, tb, cfg.n_kv_heads,
+                                                cfg.head_dim)
+                vv = qdot(h, blk["wv"]).reshape(M, tb, cfg.n_kv_heads,
+                                                cfg.head_dim)
+                q, kk = apply_rope(q, angles), apply_rope(kk, angles)
+                if tp_axis is not None:
+                    # Island mode: the gathered prefix (pk_l/pv_l) is
+                    # this shard's kv-head slice of the pool, so the
+                    # tail's q/k/v slice to the matching head family;
+                    # the scan ys (kk, vv) stay local — they are
+                    # exactly the rows this shard's pool scatter stores.
+                    q = _tp_heads(q, tp_axis,
+                                  (cfg.n_heads // tp), 2)
+                    kk = _tp_heads(kk, tp_axis, hkv_loc, 2)
+                    vv = _tp_heads(vv, tp_axis, hkv_loc, 2)
+                h_kv = kk.shape[2]
+                qg = q.reshape(M, tb, h_kv, g, cfg.head_dim)
+                kf = jnp.concatenate([pk_l, kk], axis=1)  # [M,hp+tb,Hkv,hd]
+                vf = jnp.concatenate([pv_l, vv], axis=1)
+                scores = jnp.einsum(
+                    "bqhgd,bkhd->bhgqk", qg, kf).astype(jnp.float32) * scale
+                scores = jnp.where(valid[:, None, None], scores, _NEG_INF)
+                probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+                attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
+                if tp_axis is not None:
+                    # Exact head-axis reassembly ([M, tb, Hkv/tp, g, hd]
+                    # → full kv-major head order — movement only).
+                    attn = jax.lax.all_gather(attn, tp_axis, axis=2,
+                                              tiled=True)
+                x = x + qdot(attn.reshape(M, tb,
+                                          cfg.n_heads * cfg.head_dim),
+                             blk["wo"])
+                x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
+                return x, (kk, vv)
+
+            x, (mk, mv) = jax.lax.scan(block, x, (params["blocks"], pk, pv))
         x = rms_norm(x, params["final_norm"])
         logits = qdot(x, params["lm_head"]).astype(jnp.float32)
 
@@ -1235,6 +1303,8 @@ class ContinuousBatcher:
                  prefix_cache: bool = False,
                  prefill_chunk_tokens: Optional[int] = None,
                  speculative: bool = False, gamma: int = 4,
+                 prefill_attn: Optional[str] = None,
+                 donate_decoded: bool = True,
                  fault_injector=None, tracer=None, clock=None,
                  flight_capacity: int = 256):
         self.params = params
@@ -1260,6 +1330,13 @@ class ContinuousBatcher:
         # engine, whose pool_metrics() is {} — must not leak host
         # memory; overflow drops the OLDEST phase observations.
         self._phase_buf: deque = deque(maxlen=4096)
+        # Per-admission prefix-cache hit lengths (tokens), drained by
+        # pool_metrics() into the tpu_serve_prefix_hit_tokens histogram
+        # — the DISTRIBUTION the cumulative hit counters cannot show
+        # (one warm conversation mounting 10k tokens vs a thousand
+        # 8-token system-prompt hits are different fleets). Bounded
+        # drop-oldest like every obs buffer.
+        self._hit_tok_buf: deque = deque(maxlen=4096)
         self._timelines: "OrderedDict[int, list]" = OrderedDict()
         self._rid_label: Dict[int, str] = {}
         self._step_faults: list = []
@@ -1295,6 +1372,30 @@ class ContinuousBatcher:
                 f"kv_layout must be 'contiguous' or 'paged', got "
                 f"{kv_layout!r}")
         self.layout = kv_layout
+        # prefill_attn: the hb>0 tail-prefill attention implementation.
+        # None/"auto" follows cfg.decode_attn (fused configs stream the
+        # cached prefix through the Pallas prefix-attention kernel,
+        # dense configs keep the materializing gather); "kernel"/
+        # "gather" force one side — the token-identity suites and the
+        # multiturn bench drive both on the same trace. Rungs the
+        # kernel's plan cannot cover fall back to the gather, counted
+        # via tpu_serve_decode_fallback_total{reason="no_prefill_plan"}.
+        if prefill_attn not in (None, "auto", "kernel", "gather"):
+            raise ValueError(
+                f"prefill_attn must be None/'auto'/'kernel'/'gather', "
+                f"got {prefill_attn!r}")
+        if prefill_attn in ("kernel", "gather") and kv_layout != "paged":
+            raise ValueError(
+                "prefill_attn requires kv_layout='paged' (the prefix-"
+                "attention prefill streams pool pages by block table)")
+        self._prefill_attn = prefill_attn or "auto"
+        # donate_decoded: at reap, donate the DECODED suffix's full
+        # pages into the radix prefix tree alongside the prompt pages,
+        # so a multi-turn conversation's next turn mounts the whole
+        # previous transcript instead of re-prefilling its own answer
+        # (_retire_pages; no-op without prefix_cache). Off = PR 4's
+        # prompt-only donation — the multiturn bench's baseline.
+        self._donate_decoded = bool(donate_decoded)
         # kv_dtype: None keeps the cache in cfg.dtype; "int8" stores K/V
         # int8 with per-token-per-head scale planes (_kv_quant) — halves
         # cache HBM traffic AND capacity cost (2x slots at fixed HBM).
@@ -1400,6 +1501,16 @@ class ContinuousBatcher:
                 # residency scales 1/tp, the capacity headroom the whole
                 # feature exists for.
                 self._reshard_pool()
+            # Per-chip pool residency, computed ONCE from the static
+            # shapes (POOL_SPEC shards the kv-heads dim evenly, so shard
+            # bytes are exactly total/tp). pool_metrics() must NOT read
+            # the live arrays for this: they are donated every dispatch,
+            # and a scrape thread racing a step would hit a deleted
+            # buffer and die (observed: addressable_shards raising
+            # "Array has been deleted" out of a scraper thread).
+            self._kv_pool_dev_bytes = int(sum(
+                a.nbytes for a in (self._k, self._v, self._ks, self._vs)
+                if a is not None) // self._tp)
             # Host mirror of the block table; the device copy is uploaded
             # (4 bytes/block — KiBs) only on steps whose admissions/frees
             # changed it, and otherwise donated through decode dispatches
@@ -1530,12 +1641,13 @@ class ContinuousBatcher:
                     out_specs=(PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_),
                     donate=(1, 2, 3, 4, 5),
                 )
+            pfa = self._prefill_attn
             self._prefill = self._jit_island(
                 lambda p, k, v, ks, vs, lens, last, slots, pids, ptbl,
                 hlens, tokens, tlens, seed: _prefill_multi_paged_fn(
                     p, cfg, ps, k, v, lens, last, slots, pids, ptbl,
                     hlens, tokens, tlens, seed, temp, tk, k_s=ks, v_s=vs,
-                    **tp_kw),
+                    prefill_attn=pfa, **tp_kw),
                 in_specs=(RE_, PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_,
                           RE_, RE_, RE_, RE_, RE_),
                 out_specs=(PS_, PS_, PS_, PS_, RE_, RE_, RE_),
@@ -1986,26 +2098,68 @@ class ContinuousBatcher:
         return min(hb, self.n_blocks)
 
     def _retire_pages(self, own: list, shared: list,
-                      prompt: Optional[list]) -> None:
-        """A request is done with its pages: donate the full-prompt-chunk
-        pages into the prefix tree where the path is new (the slot's
-        reference transfers — models/prefix_cache.py insert), and drop
-        one reference on everything else — the shared hit pages it
-        mounted (tree/other slots keep theirs) and its own partial/decode
-        pages (refcount 0 → back to the free list)."""
+                      prompt: Optional[list],
+                      decoded: Optional[list] = None) -> None:
+        """A request is done with its pages: donate the full-chunk pages
+        of its CONVERSATION — prompt plus (donate_decoded) the decoded
+        tokens the caller verified have resident KV rows — into the
+        prefix tree where the path is new (the slot's reference
+        transfers — models/prefix_cache.py insert), and drop one
+        reference on everything else — the shared hit pages it mounted
+        (tree/other slots keep theirs) and its own partial/decode pages
+        (refcount 0 → back to the free list). Donating the decoded
+        suffix is what makes turn N+1 of a conversation mount turn N's
+        ENTIRE transcript instead of re-prefilling its own answer
+        (SGLang's RadixAttention framing: the cacheable prefix is the
+        whole conversation, not just the prompt); the partial last page
+        stays owner-freed as always — only full pages donate."""
         adopted: set = set()
         if self._prefix is not None and prompt is not None:
-            n_full = len(prompt) // self.page_size
+            conv = list(prompt) + list(decoded or ())
+            n_full = min(len(conv) // self.page_size, len(shared) + len(own))
             adopted = set(self._prefix.insert(
-                prompt, (shared + own)[:n_full]))
+                conv[:n_full * self.page_size], (shared + own)[:n_full],
+                prompt_len=len(prompt)))
         release = [p for p in shared + own if p not in adopted]
         if release:
             self._alloc.free(release)
 
-    def _free_slot_pages(self, slot: int) -> None:
+    def _donatable_decoded(self, rid: int) -> list:
+        """The prefix of a request's emitted stream whose KV rows are
+        VERIFIABLY resident in its pages — what _retire_pages may donate
+        beyond the prompt. The bound is host-derivable with no device
+        sync: emitted[i] was sampled AFTER emitted[i-1]'s KV row was
+        written, so rows exist for every flushed token but the last
+        (``raw[:-1]``); the eos-truncated stream is additionally capped
+        there so post-eos garbage rows never enter the tree (they could
+        never match a follow-up prompt, which continues from the eos).
+        Budget-reaped requests in deferred-readback mode donate only the
+        flushed prefix — conservative by design; the multi-turn path
+        (eos reaps, spec commits) flushes before reaping and donates the
+        full transcript."""
+        if not self._donate_decoded or self._prefix is None:
+            return []
+        raw = self._out.get(rid)
+        if not raw or len(raw) < 2:
+            return []
+        trunc = self._truncate_eos(list(raw))
+        return [int(t) for t in trunc[:min(len(trunc), len(raw) - 1)]]
+
+    def _free_slot_pages(self, slot: int,
+                         decoded: Optional[list] = None) -> None:
+        """Retire a slot's whole reservation. Owns the mid-prefill
+        bookkeeping: a slot still in ``_prefill_pending`` has only
+        ``prefill_done`` prompt rows resident, so the donation is capped
+        there (donating beyond would cache pages whose KV was never
+        written — garbage served to every future match); fully-prefilled
+        slots donate prompt + the caller's verified decoded suffix."""
+        prompt = self._slot_prompt.pop(slot, None)
+        done = self._prefill_pending.pop(slot, None)
+        if done is not None and prompt is not None:
+            prompt, decoded = prompt[:done], None
         self._retire_pages(self._slot_pages.pop(slot),
                            self._slot_shared.pop(slot, []),
-                           self._slot_prompt.pop(slot, None))
+                           prompt, decoded)
         self._table_np[slot] = NULL_PAGE
         self._table_dirty = True
 
@@ -2081,6 +2235,12 @@ class ContinuousBatcher:
             self._table_dirty = True
             hit_tok = len(hits) * self.page_size
             self._skipped_tokens += hit_tok
+            if self._prefix is not None:
+                # Per-admission hit-length observation (misses count as
+                # 0 — the histogram's head is the miss mass, its tail
+                # the warm-conversation mounts).
+                with self._obs_mu:
+                    self._hit_tok_buf.append(hit_tok)
             # Bucket the UNCACHED TAIL, rounded up to page granularity:
             # the prefill scatter writes whole page blocks, so tb must be
             # a page multiple (ladder rungs below page_size round up to
@@ -2424,7 +2584,12 @@ class ContinuousBatcher:
                 del self._budget[req_id]
                 del self._slot_req[slot]             # slot free NOW
                 t_rp = self._clock.monotonic()
-                self._free_slot_pages(slot)          # pages free NOW too
+                # Pages free NOW too; the flushed emitted prefix rides
+                # into the tree as the decoded-suffix donation (this
+                # step's still-deferred chunk is not host-visible yet —
+                # the conservative bound _donatable_decoded documents).
+                self._free_slot_pages(
+                    slot, self._donatable_decoded(req_id))
                 if self._tracer is not None:
                     self._obs_span("reap", t_rp, self._clock.monotonic(),
                                    rid=req_id, slot=slot)
@@ -2580,7 +2745,10 @@ class ContinuousBatcher:
                 del self._budget[req_id]
                 del self._slot_req[slot]             # slot free NOW
                 t_rp = self._clock.monotonic()
-                self._free_slot_pages(slot)          # pages free NOW too
+                # Spec commits land in _out synchronously above, so the
+                # decoded-suffix donation sees the full committed stream.
+                self._free_slot_pages(
+                    slot, self._donatable_decoded(req_id))
                 if self._tracer is not None:
                     self._obs_span("reap", t_rp, self._clock.monotonic(),
                                    rid=req_id, slot=slot)
@@ -2642,10 +2810,13 @@ class ContinuousBatcher:
         self._eos_scanned.pop(rid, None)
         if self.spec:
             self._spec_mirror.pop(slot, None)
-        if self.layout == "paged":
-            self._prefill_pending.pop(slot, None)
         if self.layout == "paged" and slot in self._slot_pages:
+            # _free_slot_pages owns the mid-prefill donation cap (it
+            # pops _prefill_pending itself); errored streams donate no
+            # decoded suffix — only rows an ordinary reap would have.
             self._free_slot_pages(slot)
+        elif self.layout == "paged":
+            self._prefill_pending.pop(slot, None)
         self._out.pop(rid, None)
         self._arrival.pop(rid, None)
         self._first_tok.pop(rid, None)
@@ -2702,7 +2873,13 @@ class ContinuousBatcher:
         part of the contract: chunking is a pure scheduling knob — a
         chunked engine's mid-prefill snapshot restores into an unchunked
         one (the tail prefills in one dispatch) and vice versa, with no
-        effect on page layout or token identity. Model WEIGHTS are the
+        effect on page layout or token identity. ``prefill_attn`` and
+        ``donate_decoded`` are likewise excluded: the prefix-attention
+        implementation is pinned token-identical to the gather by the
+        parity suites (and follows ``decode_attn`` — which IS recorded —
+        in auto mode), and decoded-suffix donation only changes what the
+        local radix tree caches, never how restored pages decode. Model
+        WEIGHTS are the
         caller's obligation: restore into an engine holding different
         params resumes streams that decode differently, and no
         fingerprint can see that."""
@@ -2908,6 +3085,12 @@ class ContinuousBatcher:
             self._shed_total += len(shed)
             for slot in shed:
                 rid = self._slot_req.pop(slot)
+                # Decoded-suffix donation BEFORE the stream migrates:
+                # the shed slot's transcript-so-far stays cached here
+                # (reclaimable capacity — the same warm-prefix argument
+                # as the prompt pages), while the request itself
+                # continues on the absorb target.
+                decoded = self._donatable_decoded(rid)
                 self._budget.pop(rid, None)
                 self._out.pop(rid, None)
                 self._eos_scanned.pop(rid, None)
@@ -2915,8 +3098,9 @@ class ContinuousBatcher:
                 self._first_tok.pop(rid, None)
                 if self.spec:
                     self._spec_mirror.pop(slot, None)
-                self._prefill_pending.pop(slot, None)
-                self._free_slot_pages(slot)
+                # _free_slot_pages pops _prefill_pending itself and caps
+                # a mid-prefill slot's donation at its resident rows.
+                self._free_slot_pages(slot, decoded)
             if self._flight is not None:
                 self._flight.record(
                     "shed", slots=len(shed), pages=len(ids),
@@ -3279,19 +3463,16 @@ class ContinuousBatcher:
         # a restore/absorb re-queued a peer's mid-prefill slot.
         out["prefill_backlog_tokens"] = float(self._prefill_backlog())
         out["prefill_chunks_total"] = float(self._prefill_chunks_total)
-        # Multi-chip islands: tp width and the PER-CHIP pool residency
-        # (shard 0's bytes across pool + scale planes — metadata reads,
-        # no device sync). Unsharded engines report the whole pool; the
-        # sharded-serving bench asserts the 1/tp scaling on this gauge.
+        # Multi-chip islands: tp width and the PER-CHIP pool residency.
+        # The value is the engine-build-time constant (shapes/shardings
+        # never change after birth), NOT a live-array read: the pool
+        # buffers are donated every dispatch, so a scrape thread racing
+        # a step would trip "Array has been deleted" on
+        # addressable_shards and kill the exporter. Unsharded engines
+        # report the whole pool; the sharded-serving bench asserts the
+        # 1/tp scaling on this gauge.
         out["tp"] = float(self._tp)
-        dev_bytes = 0
-        for arr in (self._k, self._v, self._ks, self._vs):
-            if arr is None:
-                continue
-            shards = getattr(arr, "addressable_shards", None)
-            dev_bytes += int(shards[0].data.nbytes if shards
-                             else arr.nbytes)
-        out["kv_pool_device_bytes"] = float(dev_bytes)
+        out["kv_pool_device_bytes"] = float(self._kv_pool_dev_bytes)
         # ONE lock snapshot for everything the step loop mutates: the
         # watchdog age, the spec gauges and the drained phase batch all
         # come from the same instant, so a scrape racing a step can
@@ -3325,6 +3506,13 @@ class ContinuousBatcher:
             if self._phase_buf:
                 out["phase_durations"] = tuple(self._phase_buf)
                 self._phase_buf.clear()
+            # Per-admission prefix-hit lengths, drained exactly once in
+            # the same lock snapshot (the phase-batch contract):
+            # export_serving_pool folds them into the
+            # tpu_serve_prefix_hit_tokens histogram.
+            if self._hit_tok_buf:
+                out["prefix_hit_token_batch"] = tuple(self._hit_tok_buf)
+                self._hit_tok_buf.clear()
         return out
 
     def _flush(self) -> None:
@@ -3395,9 +3583,12 @@ class ContinuousBatcher:
                 self._eos_scanned.pop(req_id, None)
                 t_rp = self._clock.monotonic()
                 if self.layout == "paged":
-                    # Early stop returns the whole worst-case reservation —
-                    # including the never-written tail — immediately.
-                    self._free_slot_pages(slot)
+                    # Early stop returns the whole worst-case reservation
+                    # — including the never-written tail — immediately;
+                    # the reap runs post-flush, so the decoded-suffix
+                    # donation covers the whole transcript through eos.
+                    self._free_slot_pages(
+                        slot, self._donatable_decoded(req_id))
                 if self._tracer is not None:
                     self._obs_span("reap", t_rp, self._clock.monotonic(),
                                    rid=req_id, slot=slot, eos=True)

@@ -67,6 +67,28 @@ row stack, so one cache read feeds every window row of every head in the
 group), int8 dequant in registers, split-K + LSE combine.
 ``dense_verify_reference`` is the grouped-einsum formulation of the same
 contract — numerical reference and automatic fallback.
+
+**Prefix-attention prefill variant** (`paged_prefill_attention`): the
+same paged kernel body generalized from the 1+gamma verify window to the
+tb-bucket PREFILL TAIL — the hb>0 rung of the serving engine's
+prefix-cache tail prefill (models/serving._prefill_multi_paged_fn). The
+q block is the tail's tb query rows (at rope offset ``hit_lens``); the
+kv grid axis streams TWO regimes: first the shared cached prefix,
+page-indirected through ``prefix_table`` exactly like the decode/verify
+kernels (int8 dequant in registers — the tail attends the SAME
+dequantized bytes decode attends), then the tail's own K/V riding as a
+dense [M, tb, Hkv, hd] operand (exact dtype — the rows this dispatch is
+about to scatter into the pool, not yet resident). The mask is
+two-regime: prefix columns fully visible below ``hit_lens``; tail
+columns per-row causal (tail col j visible to tail query i iff j <= i).
+This replaces the dense O(hit_len) HBM gather
+(``pool[:, prefix_tables]`` → [L, M, hb·ps, Hkv, hd], dequantized to a
+full-dtype buffer) with blockwise O(hit+tail) streaming — the gather
+grew linearly with exactly the cache hits the prefix cache exists to
+maximize. ``dense_prefill_reference`` is the gather+einsum formulation
+of the same contract — numerical reference and automatic fallback
+(``prefill_plan`` gates rungs whose tb·g q-row stack would overflow
+VMEM; see analysis/vmem.py paged_prefill_attention_footprint).
 """
 from __future__ import annotations
 
@@ -791,6 +813,360 @@ def paged_verify_attention(
     out = _combine_splits(acc, m, l, b, n_kv * t * g, hd, q.dtype)
     return out.reshape(b, n_kv, t, g, hd).transpose(0, 2, 1, 3, 4) \
         .reshape(b, t, n_heads, hd)
+
+
+# -- prefix-attention prefill kernel ------------------------------------------
+
+# Cap on the q-row stack (tb tail rows x g group heads) one prefill
+# program may carry: beyond it the [rows, hd] q block, three [rows, *]
+# partial outputs and the (acc, m, l) scratch brush the 16 MiB/core VMEM
+# budget on the large presets (the precise per-preset accounting is
+# analysis/vmem.py paged_prefill_attention_footprint — this is the
+# coarse runtime gate; rungs past it fall back to the dense gather,
+# counted). Production long prompts ride chunked prefill, whose chunk
+# buckets sit far below the cap.
+PREFILL_MAX_Q_ROWS = 2048
+
+
+def prefill_plan(n_blocks: int, page_size: int, rows: int,
+                 n_splits: Optional[int] = None) -> Optional[int]:
+    """Legal split count for a prefix-attention prefill of ``rows`` q
+    rows (tb tail tokens x g group heads) over ``n_blocks`` logical kv
+    blocks (prefix pages ++ tail pages, each ``page_size`` rows), or
+    None when not coverable: the kv side is exactly ``paged_plan`` (the
+    page is the kv block); the q side is capped at ``PREFILL_MAX_Q_ROWS``
+    — the VMEM wall the multi-row q stack hits long before the kv
+    traffic does."""
+    if rows < 1 or rows > PREFILL_MAX_Q_ROWS:
+        return None
+    return paged_plan(n_blocks, page_size, n_splits)
+
+
+def dense_prefill_reference(q: jax.Array, k_pages: jax.Array,
+                            v_pages: jax.Array, prefix_table: jax.Array,
+                            hit_lens, tail_k: jax.Array, tail_v: jax.Array,
+                            k_scale=None, v_scale=None) -> jax.Array:
+    """Gather+einsum prefix-attention prefill: the tail window q
+    [M, tb, H, hd] against [the cached prefix gathered from the page
+    pool through ``prefix_table`` [M, hb]] ++ [the tail's own K/V
+    [M, tb, Hkv, hd]] → [M, tb, H, hd].
+
+    ``hit_lens`` (scalar or [M] int32) counts each entry's cached
+    prefix rows (page-aligned, <= hb·page_size; ``prefix_table`` may be
+    null-padded past them). Prefix column c is visible iff c < hit_len
+    — fully visible, no causal order (the whole prefix precedes every
+    tail query); tail column j is visible to tail query i iff j <= i —
+    causal inside the window. Tail query i sits at absolute position
+    hit_len + i; rope must already be applied to q and tail_k at those
+    offsets (this function only contracts). int8-KV mode
+    (``k_scale``/``v_scale`` [n_pages, ps, Hkv, 1]) dequantizes the
+    GATHERED prefix only — the tail K/V are the exact-dtype rows this
+    dispatch computes, the same asymmetry the serving gather path has
+    always had (its parity note in models/serving.py). This is the
+    materializing formulation the kernel replaces: the numerical
+    reference and the automatic fallback."""
+    m, tb, n_heads, hd = q.shape
+    ps, h_kv = k_pages.shape[1], k_pages.shape[2]
+    if n_heads % h_kv:
+        raise ValueError(
+            f"GQA needs n_heads ({n_heads}) divisible by kv heads ({h_kv})")
+    hb = prefix_table.shape[1]
+    hp = hb * ps
+    quant = k_scale is not None
+    if quant and v_scale is None:
+        raise ValueError("int8-KV mode needs both k_scale and v_scale")
+    hit_lens = jnp.asarray(hit_lens, jnp.int32)
+    if hit_lens.ndim == 0:
+        hit_lens = jnp.full((m,), hit_lens, jnp.int32)
+
+    def gather(pool):
+        return pool[prefix_table].reshape(m, hp, *pool.shape[2:])
+
+    if quant:
+        pk = (gather(k_pages).astype(jnp.float32)
+              * gather(k_scale)).astype(q.dtype)
+        pv = (gather(v_pages).astype(jnp.float32)
+              * gather(v_scale)).astype(q.dtype)
+    else:
+        pk, pv = gather(k_pages), gather(v_pages)
+    kf = jnp.concatenate([pk, tail_k], axis=1)       # [M, hp+tb, Hkv, hd]
+    vf = jnp.concatenate([pv, tail_v], axis=1)
+    kcol = jnp.arange(hp + tb)[None, None, :]
+    valid = jnp.where(
+        kcol < hp, kcol < hit_lens[:, None, None],
+        (kcol - hp) <= jnp.arange(tb)[None, :, None])  # [M, tb, hp+tb]
+    g = n_heads // h_kv
+    scale = 1.0 / math.sqrt(hd)
+    qg = q.reshape(m, tb, h_kv, g, hd)
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, kf).astype(jnp.float32) * scale
+    scores = jnp.where(valid[:, None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
+    return out.reshape(m, tb, n_heads, hd)
+
+
+def _prefill_kernel(hit_lens_ref, table_ref, q_ref, pk_ref, pv_ref,
+                    tk_ref, tv_ref, *rest, scale: float, ps: int,
+                    n_kv: int, bps: int, hb: int, quant: bool, tb: int,
+                    g: int):
+    """Prefix-attention prefill body: the q block is one slot's whole
+    [tb·g, hd] tail-row stack for one kv head group (row i·g+j = tail
+    token i, group head j — the verify kernel's fold at t = tb). The
+    logical kv axis has TWO regimes split at the static block index
+    ``hb``: blocks < hb stream cached prefix pages through the table
+    indirection (int8 dequant in registers, mask col < hit_len — fully
+    visible, no causal order); blocks >= hb stream the tail's own dense
+    K/V (exact dtype, per-row causal mask tail-col <= tail-row). Both
+    regimes feed the SAME online-softmax update, so each tail row
+    accumulates exactly what the dense two-regime mask admits."""
+    del table_ref                # consumed by the BlockSpec index maps only
+    if quant:
+        pks_ref, pvs_ref, *rest = rest
+    o_ref, mo_ref, lo_ref, acc_ref, m_ref, l_ref = rest
+
+    bh = pl.program_id(0)
+    split = pl.program_id(1)
+    j = pl.program_id(2)
+    b = bh // n_kv
+    blk = split * bps + j                      # UNclamped LOGICAL kv block
+    hit = hit_lens_ref[b]                      # cached prefix rows
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def accum(kb, vb, mask):
+        # One flash update with this block's [ps, hd] K/V under ``mask``
+        # [rows-or-1, ps] — shared verbatim by both regimes, so the
+        # running (m, l, acc) stats cannot drift between them.
+        q = q_ref[0].astype(jnp.float32)                   # [tb*g, hd]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                          # [tb*g, ps]
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                              # [tb*g, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # Explicit zero at masked columns: a block no row attends yet
+        # leaves m_new at -inf and exp(s - m_new) == 1 without it.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    # Prefix regime: blocks past ceil(hit/ps) are compute-skipped (their
+    # index maps clamp to the last valid prefix page — resident, no dead
+    # DMA), the last partial page is column-masked.
+    @pl.when(jnp.logical_and(blk < hb, blk * ps < hit))
+    def _prefix_update():
+        k = pk_ref[0, :, 0, :].astype(jnp.float32)         # [ps, hd]
+        v = pv_ref[0, :, 0, :].astype(jnp.float32)
+        if quant:
+            k = k * pks_ref[0, :, 0, :]                    # dequant in regs
+            v = v * pvs_ref[0, :, 0, :]
+        col = blk * ps + jax.lax.broadcasted_iota(
+            jnp.int32, (1, ps), 1)
+        accum(k, v, col < hit)                             # fully visible
+
+    # Tail regime: per-row causal inside the window. Every tail block is
+    # live (the bucket's padded rows attend their own causal prefix and
+    # are discarded by the caller), so no skip bound.
+    @pl.when(blk >= hb)
+    def _tail_update():
+        k = tk_ref[0, 0, :, 0, :].astype(jnp.float32)      # [ps, hd]
+        v = tv_ref[0, 0, :, 0, :].astype(jnp.float32)
+        tcol = (blk - hb) * ps + jax.lax.broadcasted_iota(
+            jnp.int32, (tb * g, ps), 1)                    # tail col idx
+        trow = jax.lax.broadcasted_iota(
+            jnp.int32, (tb * g, ps), 0) // g               # tail token idx
+        accum(k, v, tcol <= trow)                          # causal window
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[0, 0] = acc_ref[:]
+        mo_ref[0, 0] = m_ref[:]
+        lo_ref[0, 0] = l_ref[:]
+
+
+def paged_prefill_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    prefix_table: jax.Array,
+    hit_lens,
+    tail_k: jax.Array,
+    tail_v: jax.Array,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    n_splits: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused prefix-attention PREFILL over a paged KV cache: the tail
+    window q [M, tb, H, hd] (tail query i at absolute position
+    hit_len + i, rope already applied) against [each entry's cached
+    prefix, streamed from the page pool k/v [n_pages, ps, Hkv, hd]
+    through ``prefix_table`` [M, hb] int32] ++ [the tail's own K/V
+    [M, tb, Hkv, hd], a dense operand — these rows are computed BY the
+    prefill dispatch and are not in the pool yet]. One dispatch prefills
+    every entry's tail — the hb>0 rung body of the serving engine's
+    prefix-cache tail prefill.
+
+    ``hit_lens`` (scalar or [M] int32) counts each entry's cached
+    prefix rows; it must be <= hb·ps (``prefix_table`` may be
+    null-padded past ceil(hit_len/ps) — those entries are never
+    streamed: the prefix index maps clamp to the last valid page, and
+    the mask bounds columns at hit_len). Prefix columns are FULLY
+    visible below hit_len (the whole prefix precedes every tail query —
+    no causal order, the decode kernels' length mask at a per-entry
+    bound); tail columns are per-row causal (col j visible to query i
+    iff j <= i — the verify kernel's in-kernel iota mask with the
+    window grown to tb rows). ``tb`` must be a multiple of ps (the
+    engine's buckets are page-quantized); padded tail rows beyond a
+    real tail compute garbage the caller discards, exactly like the
+    dense path's bucket padding.
+
+    ``k_scale``/``v_scale`` [n_pages, ps, Hkv, 1] switch the POOL
+    operands to int8-KV mode — the prefix is dequantized in registers
+    (the same bytes decode attends); the tail K/V stay exact dtype,
+    mirroring the gather path's asymmetry. hb == 0 (nothing cached) is
+    the degenerate pure-causal window: internally one null prefix block
+    rides masked-out so the program shape stays uniform.
+
+    Raises ValueError when ``prefill_plan`` has no legal covering (tb·g
+    q rows past PREFILL_MAX_Q_ROWS, or an unpageable shape) — callers
+    that want silent degradation check the plan first and fall back to
+    ``dense_prefill_reference``."""
+    m, tb, n_heads, hd = q.shape
+    if k_pages.shape[3] != hd or v_pages.shape != k_pages.shape:
+        raise ValueError(f"page pool shape {k_pages.shape}/{v_pages.shape} "
+                         f"does not match q {q.shape}")
+    if prefix_table.ndim != 2 or prefix_table.shape[0] != m:
+        raise ValueError(f"prefix_table must be [M={m}, hb], got "
+                         f"{prefix_table.shape}")
+    if tail_k.shape != (m, tb, k_pages.shape[2], hd) \
+            or tail_v.shape != tail_k.shape:
+        raise ValueError(f"tail K/V {tail_k.shape}/{tail_v.shape} must be "
+                         f"[M={m}, tb={tb}, Hkv={k_pages.shape[2]}, "
+                         f"hd={hd}]")
+    ps, n_kv = k_pages.shape[1], k_pages.shape[2]
+    if n_heads % n_kv:
+        raise ValueError(
+            f"GQA needs n_heads ({n_heads}) divisible by kv heads ({n_kv})")
+    if tb % ps:
+        raise ValueError(f"tail bucket tb={tb} must be a multiple of the "
+                         f"page size {ps}")
+    g = n_heads // n_kv
+    hb = prefix_table.shape[1]
+    if hb == 0:
+        # Degenerate pure-causal window: one null prefix block, fully
+        # masked (hit_lens must be 0), keeps the two-regime program
+        # shape without a second kernel body.
+        prefix_table = jnp.zeros((m, 1), jnp.int32)
+        hb = 1
+    ntb = tb // ps
+    n_blocks = hb + ntb
+    n_splits = prefill_plan(n_blocks, ps, tb * g, n_splits)
+    if n_splits is None:
+        raise ValueError(f"no legal prefill blocking for hb={hb}, tb={tb}, "
+                         f"page_size={ps}, g={g}")
+    bps = n_blocks // n_splits
+    quant = k_scale is not None
+    if quant and v_scale is None:
+        raise ValueError("int8-KV mode needs both k_scale and v_scale")
+    from . import pallas_interpret
+    interpret = pallas_interpret(interpret)
+
+    hit_lens = jnp.asarray(hit_lens, jnp.int32)
+    if hit_lens.ndim == 0:
+        hit_lens = jnp.full((m,), hit_lens, jnp.int32)
+    prefix_table = jnp.asarray(prefix_table, jnp.int32)
+    # [M, tb, H, hd] → [M·Hkv, tb·g, hd]: the verify kernel's fold at
+    # t = tb — one cache sweep feeds every tail row of a head group.
+    q4 = q.reshape(m, tb, n_kv, g, hd).transpose(0, 2, 1, 3, 4) \
+        .reshape(m * n_kv, tb * g, hd)
+    # Tail K/V blocked along tb: [M, ntb, ps, Hkv, hd] so a tail block
+    # is addressable by its logical index like a page.
+    tk5 = tail_k.reshape(m, ntb, ps, n_kv, hd)
+    tv5 = tail_v.reshape(m, ntb, ps, n_kv, hd)
+
+    def pool_map(bh, split, j, hits, table):
+        bb = bh // n_kv
+        blk = split * bps + j
+        # Prefix regime naming: clamp into [0, hb) AND past the filled
+        # prefix (ceil(hit/ps) pages) — tail-regime steps re-name the
+        # last valid prefix page, which is resident: no dead DMA.
+        last = jnp.maximum(jax.lax.div(hits[bb] + ps - 1, ps) - 1, 0)
+        pblk = jnp.minimum(jnp.minimum(blk, hb - 1), last)
+        return (table[bb, pblk], 0, bh % n_kv, 0)
+
+    def tail_map(bh, split, j, hits, table):
+        bb = bh // n_kv
+        blk = split * bps + j
+        # Tail regime naming: clamp into [0, ntb) — prefix-regime steps
+        # re-name tail block 0 (resident after its first fetch).
+        tblk = jnp.clip(blk - hb, 0, ntb - 1)
+        return (bb, tblk, 0, bh % n_kv, 0)
+
+    pool_spec = pl.BlockSpec((1, ps, 1, hd), pool_map)
+    tail_spec = pl.BlockSpec((1, 1, ps, 1, hd), tail_map)
+    in_specs = [
+        pl.BlockSpec((1, tb * g, hd),
+                     lambda bh, split, j, hits, table: (bh, 0, 0)),
+        pool_spec,
+        pool_spec,
+        tail_spec,
+        tail_spec,
+    ]
+    inputs = [q4, k_pages, v_pages, tk5, tv5]
+    if quant:
+        sc_spec = pl.BlockSpec((1, ps, 1, 1), pool_map)
+        in_specs += [sc_spec, sc_spec]
+        inputs += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+
+    part_spec = lambda lanes: pl.BlockSpec(                      # noqa: E731
+        (1, 1, tb * g, lanes),
+        lambda bh, split, j, hits, table: (bh, split, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(m * n_kv, n_splits, bps),
+        in_specs=in_specs,
+        out_specs=[part_spec(hd), part_spec(_LANES), part_spec(_LANES)],
+        scratch_shapes=[
+            pltpu.VMEM((tb * g, hd), jnp.float32),     # acc
+            pltpu.VMEM((tb * g, _LANES), jnp.float32),  # m
+            pltpu.VMEM((tb * g, _LANES), jnp.float32),  # l
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel, scale=1.0 / math.sqrt(hd), ps=ps, n_kv=n_kv,
+        bps=bps, hb=hb, quant=quant, tb=tb, g=g)
+    acc, mm, ll = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((m * n_kv, n_splits, tb * g, hd),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((m * n_kv, n_splits, tb * g, _LANES),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((m * n_kv, n_splits, tb * g, _LANES),
+                                 jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(hit_lens, prefix_table, *inputs)
+    out = _combine_splits(acc, mm, ll, m, n_kv * tb * g, hd, q.dtype)
+    return out.reshape(m, n_kv, tb, g, hd).transpose(0, 2, 1, 3, 4) \
+        .reshape(m, tb, n_heads, hd)
 
 
 def contiguous_as_paged(cache: jax.Array, block_k: int):

@@ -1,0 +1,26 @@
+"""Seeded-bad fixture: a prefix-attention prefill footprint over the
+VMEM budget.
+
+Same ``GRAFTCHECK_VMEM_AUDIT`` hook protocol as bad_vmem.py /
+bad_vmem_paged.py / bad_vmem_verify.py, tail-prefill edition: the page
+blocks here are MODEST (64-row int8 pages — nothing the decode budgeter
+would flag), but a 1024-token tail bucket over an 8-head GQA group at
+hd=256 stacks tb·g = 8192 q rows, so the q block + three partial
+outputs + (acc, m, l) scratch alone blow past the 16 MiB core — the
+"skip chunked prefill and dispatch the whole long prompt as one rung"
+tuning mistake the prefill footprint's q-window multiplier exists to
+catch before Mosaic does, in production, at the first long-prompt
+admission. (The runtime guard is ops.prefill_plan's PREFILL_MAX_Q_ROWS
+cap; this fixture models the cliff an edit raising that cap without
+re-running the budgeter would reopen.)
+"""
+from k8s_gpu_scheduler_tpu.analysis.vmem import (
+    paged_prefill_attention_footprint,
+)
+
+GRAFTCHECK_VMEM_AUDIT = [
+    ("oversized_prefill_window",
+     paged_prefill_attention_footprint(page_size=64, g=8, hd=256,
+                                       hb=16, tb=1024, batch=8,
+                                       quant=True)),
+]

@@ -98,7 +98,12 @@ class TestTokenIdentity:
     CELLS = [
         ("dense", None, False, False),
         ("fused", "int8", True, False),
-        ("fused", "int8", False, True),
+        # PR 13 rebalance: the fused-int8 SPEC cell rides slow too — the
+        # kept fused-int8-prefix cell drives the same kernel
+        # continuation rungs tier-1, spec×chunked identity rides the
+        # unfiltered CI run.
+        pytest.param("fused", "int8", False, True,
+                     marks=pytest.mark.slow),
         pytest.param("dense", None, True, True, marks=pytest.mark.slow),
         pytest.param("fused", None, False, False, marks=pytest.mark.slow),
         pytest.param("dense", "int8", True, False, marks=pytest.mark.slow),

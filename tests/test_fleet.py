@@ -450,6 +450,37 @@ class TestRouterEndToEnd:
         assert router.locate(f1)[0] == first
         router.run()
 
+    def test_followup_turn_routes_to_conversation_replica(self, setup):
+        """The 2-turn chat edition of affinity-follows-warm-cache: turn
+        1 lands somewhere, its reap donates PROMPT + DECODED pages into
+        that replica's tree, the digest publishes the transcript — so
+        turn 2 (whose prompt IS the transcript + new user text) must
+        route back to the replica holding the conversation, and its
+        prefill must actually skip the transcript's pages."""
+        cfg, params = setup
+        router = Router([("r0", mk_engine(params, cfg)),
+                         ("r1", mk_engine(params, cfg))])
+        rng = np.random.default_rng(7)
+        p1 = list(rng.integers(0, cfg.vocab, 2 * PAGE))
+        f1 = router.submit(p1, max_new=12)
+        first = router.locate(f1)[0]
+        done = router.run()
+        turn1 = done[f1]
+        holder = router._replica(first).engine
+        assert holder.pool_metrics()["decoded_pages_donated_total"] >= 1
+        skipped0 = holder.pool_metrics()["prefill_tokens_skipped"]
+        # Turn 2: the whole transcript + new user text. The digest now
+        # carries the conversation path (prompt + decoded), so the
+        # match length dominates the otherwise-identical scores.
+        p2 = p1 + turn1 + list(rng.integers(0, cfg.vocab, 3))
+        f2 = router.submit(p2, max_new=4)
+        assert router.locate(f2)[0] == first
+        router.run()
+        skipped = holder.pool_metrics()["prefill_tokens_skipped"] - skipped0
+        conv = len(p1) + len(turn1) - 1
+        assert skipped >= (conv // PAGE) * PAGE > len(p1)
+        holder._alloc.assert_consistent()
+
     def test_stale_summaries_degrade_to_round_robin(self, setup):
         cfg, params = setup
         clock = VirtualClock()

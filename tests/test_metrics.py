@@ -243,6 +243,36 @@ class TestServingPoolExport:
         # Every exported key is documented in the gauge map.
         assert set(snapshot) <= set(SERVING_POOL_GAUGES)
 
+    def test_prefix_hit_tokens_histogram_and_decoded_gauge(self):
+        """The multi-turn metrics surface: per-admission hit lengths
+        fold into the tpu_serve_prefix_hit_tokens HISTOGRAM (misses at
+        0, transcript mounts in the tail, _sum = the old cumulative
+        gauge's value), decoded donations ride the
+        tpu_serve_decoded_pages_donated_total gauge, and the batch
+        drains once like the phase batch."""
+        from k8s_gpu_scheduler_tpu.metrics import export_serving_pool
+        from k8s_gpu_scheduler_tpu.metrics.exporter import (
+            PREFIX_HIT_HISTOGRAM, SERVING_POOL_GAUGES,
+        )
+
+        assert "decoded_pages_donated_total" in SERVING_POOL_GAUGES
+        reg = Registry()
+        export_serving_pool(reg, {
+            "decoded_pages_donated_total": 3.0,
+            "prefix_hit_token_batch": (0, 8, 512),
+        })
+        text = reg.expose()
+        assert "tpu_serve_decoded_pages_donated_total 3.0" in text
+        assert f'{PREFIX_HIT_HISTOGRAM}_bucket{{le="8.0"}} 2' in text
+        assert f"{PREFIX_HIT_HISTOGRAM}_count 3" in text
+        assert f"{PREFIX_HIT_HISTOGRAM}_sum 520.0" in text
+        # Labeled (fleet) series ride the same histogram machinery.
+        reg2 = Registry()
+        export_serving_pool(reg2, {"prefix_hit_token_batch": (64,)},
+                            labels={"replica": "r0"})
+        assert (f'{PREFIX_HIT_HISTOGRAM}_count{{replica="r0"}} 1'
+                in reg2.expose())
+
     def test_replica_labeled_export_and_unlabeled_byte_identity(self):
         """The fleet tier publishes each replica under {replica=}: the
         labeled series ride the SAME gauges/histogram, and a caller

@@ -220,6 +220,10 @@ class TestPrefixEngineParity:
         assert m["pages_in_use"] == m["pages_cached"] > 0
         eng._alloc.assert_consistent()
 
+    # PR 13 rebalance: the fused-int8 production cell above stays
+    # tier-1; the bf16 near-tie noise class is documented and this cell
+    # rides the unfiltered CI run.
+    @pytest.mark.slow
     def test_bf16_cache_on_matches_cache_off(self):
         cfg, params, prompts = self._setup(dtype=jnp.bfloat16,
                                            decode_attn="fused")

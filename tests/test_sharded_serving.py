@@ -76,13 +76,22 @@ def mixed_prompts(cfg, seed=0, n=4):
     return out
 
 
+# Tier-1 wall-clock rebalance (the PR 5/8 pattern, applied as PR 13's
+# additions brought the suite back to the 870 s budget): cells whose
+# feature combination is a strict subset of a kept cell ride
+# pytest.mark.slow — the plain/int8-prefix/dense cells and the
+# int8-spec-prefix SUPERSET stay tier-1, and the unfiltered CI pytest
+# run still executes every cell on every push.
 GRID = [
     dict(),
-    dict(kv_dtype="int8"),
+    pytest.param(dict(kv_dtype="int8"), marks=pytest.mark.slow),
     dict(kv_dtype="int8", prefix_cache=True),
-    dict(prefix_cache=True, prefill_chunk_tokens=8),
-    dict(kv_dtype="int8", prefill_chunk_tokens=8),
-    dict(speculative=True, gamma=2),
+    pytest.param(dict(prefix_cache=True, prefill_chunk_tokens=8),
+                 marks=pytest.mark.slow),
+    pytest.param(dict(kv_dtype="int8", prefill_chunk_tokens=8),
+                 marks=pytest.mark.slow),
+    pytest.param(dict(speculative=True, gamma=2),
+                 marks=pytest.mark.slow),
     dict(kv_dtype="int8", speculative=True, gamma=2, prefix_cache=True),
     dict(dense=True, kv_dtype="int8"),
     dict(dense=True),
