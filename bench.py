@@ -1138,8 +1138,10 @@ def bench_analysis(smoke=False):
     repo, recorded in BENCH_r*.json so lint latency is a tracked metric —
     a pass that quietly grows from 2 s to 2 minutes is a CI tax nobody
     budgeted. ``--smoke`` (and the headline value either way) times the
-    FAST passes (AST lint + VMEM — what tier-1 runs every collection);
-    the full four-pass wall time rides in ``extra`` unless smoking."""
+    FAST passes (AST lint + lock-order + VMEM — what tier-1 runs every
+    collection); the full ten-pass wall time (jaxpr, recompile, alias,
+    gspmd, symbolic traffic) rides in ``extra`` unless smoking, one
+    ``analysis_<pass>_s`` key per pass."""
     if not smoke:
         # Mirror the CLI's env (analysis/__main__.py): the traced passes
         # want hermetic CPU and a multi-device mesh for the pipeline entry

@@ -1,6 +1,6 @@
 """graftcheck — static analysis for the jax_graft serving/training stack.
 
-Four coordinated passes over the repo (``python -m
+Ten coordinated passes over the repo (``python -m
 k8s_gpu_scheduler_tpu.analysis``; importable APIs below):
 
 1. **AST lint** (``astlint``): jit-hostile patterns (tracer casts, host
@@ -41,16 +41,50 @@ k8s_gpu_scheduler_tpu.analysis``; importable APIs below):
    huge may be annotated fully-replicated. Tracing-only (no
    compilation), so ``make lint`` runs it too (``--fast --gspmd``).
 
+9. **Symbolic traffic audit** (``traffic`` + ``entrypoints``): walks
+   each registered serving entry point's jaxpr and costs every
+   equation's result bytes symbolically in the pool geometry dims
+   (n_pages, S, hit = hb·ps, tb, W = 1+γ, M), then checks the measured
+   scaling class against the per-entry TRAFFIC CONTRACT the registry
+   declares — rules ``traffic-contract`` (measured class exceeds
+   declared, contract missing, island pool-dim not 1/tp),
+   ``dense-materialization`` (full-pool or slots×prefix-window
+   intermediates — the PR 13 dense prefix gather class; the retained
+   gather fallback is the one sanctioned carrier) and
+   ``peak-residency`` (donation-aware liveness: pool-scale live-bytes
+   high-water vs the declared multiple of the pool — broken donation
+   reads as an exact 2× copy). Tracing only; runs in the full CLI.
+10. **Lock-order & donated-buffer audit** (``lockorder``, fast): the
+   lock-lint's lock→attr map extended into a repo-wide
+   lock-acquisition-ORDER graph — ``lock-cycle`` (potential deadlocks,
+   incl. re-acquiring a non-reentrant lock), ``use-after-donate``
+   (host reads of engine attrs aliasing per-dispatch-donated device
+   arrays outside the step path — the pool_metrics scrape-race class)
+   and ``torn-snapshot`` (multi-gauge drains split across acquisitions
+   of one lock — the PR 7 exporter class). Plus the suppression-policy
+   lint ``bare-suppression`` (findings.py, rides the AST pass): a
+   ``# graftcheck: ignore[rule]`` with no rationale is itself a
+   finding, and the README suppression catalogue is regenerated from
+   the tree (``--suppressions``).
+
 Suppression: ``# graftcheck: ignore[rule]`` on the offending line, with a
-rationale in the surrounding comment (policy in README).
+rationale in the surrounding comment (policy in README; enforced by
+``bare-suppression``).
 
 The AST + VMEM passes are import-light and fast — ``make lint`` and the
 tier-1 gate (tests/test_graftcheck_clean.py) run only those; the traced
 passes add a few seconds and run in the full CLI and their own tests.
 """
-from .findings import ALL_RULES, Finding, Report, parse_suppressions
+from .findings import (
+    ALL_RULES, Finding, Report, lint_suppressions, parse_suppressions,
+    suppression_catalogue,
+)
 from .alias import audit_shared_pages, check_shared_pages
 from .astlint import lint_source, run_astlint
+from .lockorder import lint_lockorder_source, run_lockorder
+from .traffic import (
+    TrafficContract, audit_traffic_callable, audit_traffic_jaxpr,
+)
 from .retrylint import lint_retry
 from .tracelint import lint_trace_calls
 from .vmem import (
@@ -77,9 +111,17 @@ __all__ = [
     "paged_verify_attention_footprint",
     "audit_shared_pages",
     "check_shared_pages",
+    "lint_lockorder_source",
+    "run_lockorder",
+    "lint_suppressions",
+    "suppression_catalogue",
+    "TrafficContract",
+    "audit_traffic_callable",
+    "audit_traffic_jaxpr",
     "run_fast_passes",
     "run_gspmd_pass",
     "run_traced_passes",
+    "run_traffic_pass",
 ]
 
 
@@ -94,9 +136,29 @@ def run_fast_passes(paths=None) -> Report:
     report = Report()
     if paths is None:
         paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    # One file walk, one read, ONE ast.parse per file shared between the
+    # AST lint and the lock-order pass (parsing dominates both; the
+    # standalone run_astlint/run_lockorder APIs still parse themselves).
+    import ast as _ast
+
+    from .astlint import iter_python_files
+
     t0 = time.perf_counter()
-    report.extend(run_astlint(paths))
-    report.pass_seconds["astlint"] = time.perf_counter() - t0
+    lock_s = 0.0
+    for path in iter_python_files(paths):
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            tree = _ast.parse(source, filename=path)
+        except SyntaxError:
+            tree = None     # lint_source re-parses and reports the error
+        report.extend(lint_source(path, source, tree=tree))
+        if tree is not None:
+            t1 = time.perf_counter()
+            report.extend(lint_lockorder_source(path, source, tree=tree))
+            lock_s += time.perf_counter() - t1
+    report.pass_seconds["astlint"] = time.perf_counter() - t0 - lock_s
+    report.pass_seconds["lockorder"] = lock_s
     t0 = time.perf_counter()
     report.extend(audit_vmem())
     for src, _attr, entries in _discover_hooks(
@@ -184,6 +246,68 @@ def run_traced_passes(paths=None) -> Report:
     gspmd = run_gspmd_pass(paths)
     report.findings.extend(gspmd.findings)
     report.pass_seconds.update(gspmd.pass_seconds)
+
+    traffic = run_traffic_pass(paths)
+    report.findings.extend(traffic.findings)
+    report.pass_seconds.update(traffic.pass_seconds)
+    return report
+
+
+def run_traffic_pass(paths=None) -> Report:
+    """Symbolic HBM-traffic/residency audit (analysis/traffic.py) over
+    the serving entry registry plus any ``GRAFTCHECK_TRAFFIC_AUDIT``
+    hooks found in ``paths``. Tracing-only — folded into the full traced
+    run. Every registered entry must declare a contract in
+    ``entrypoints.TRAFFIC_CONTRACTS``; a missing one is itself a
+    finding (an unstated complexity class cannot regress)."""
+    import time
+
+    from . import entrypoints as eps
+    from .traffic import TrafficContract, audit_traffic_callable
+
+    report = Report()
+    t0 = time.perf_counter()
+    contracts = eps.traffic_contracts()
+    for name, build in eps.traffic_entrypoints():
+        contract = contracts.get(name)
+        if contract is None:
+            report.extend([Finding(
+                "traffic-contract", f"<traffic:{name}>", 0,
+                f"{name}: registered serving entry point declares NO "
+                f"traffic contract — add one to "
+                f"entrypoints.TRAFFIC_CONTRACTS (decode O(pos), verify "
+                f"O(pos+γ), prefill O(hit+tail), …)")])
+            continue
+        try:
+            fn, args = build()
+        except Exception as e:  # noqa: BLE001 — a broken builder is a finding
+            report.extend([Finding(
+                "traffic-trace-error", f"<traffic:{name}>", 0,
+                f"could not build {name}: {type(e).__name__}: "
+                f"{str(e)[:300]}")])
+            continue
+        report.extend(audit_traffic_callable(
+            fn, args, name, eps.TRAFFIC_GEOMETRY, contract))
+    for src, attr, entries in _discover_hooks(
+            paths, ("GRAFTCHECK_TRAFFIC_AUDIT",)):
+        for entry in _safe_entries(report, src, attr, entries, arity=5):
+            name, fn, args, geometry, contract = entry
+            if contract is None:
+                report.extend([Finding(
+                    "traffic-contract", src, 0,
+                    f"{name}: hook entry declares no traffic contract")])
+                continue
+            try:
+                contract = (contract if isinstance(contract, TrafficContract)
+                            else TrafficContract(**dict(contract)))
+            except Exception as e:  # noqa: BLE001 — malformed hook contract
+                report.extend([Finding("hook-error", src, 0,
+                                       f"{attr}: bad contract for {name}: "
+                                       f"{e}")])
+                continue
+            report.extend(audit_traffic_callable(
+                fn, args, name, dict(geometry), contract))
+    report.pass_seconds["traffic"] = time.perf_counter() - t0
     return report
 
 

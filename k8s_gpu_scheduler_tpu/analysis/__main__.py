@@ -1,12 +1,17 @@
 """graftcheck CLI — ``python -m k8s_gpu_scheduler_tpu.analysis [paths...]``.
 
-Default: all four passes (AST lint, VMEM budgeter, jaxpr audit, recompile
-guard) over the package tree plus any extra ``paths``. Exit code 0 iff no
-error-severity findings; findings print as ``file:line: [rule] message``.
+Default: all ten passes (AST lint incl. retry/trace/suppression lints,
+lock-order audit, VMEM budgeter, jaxpr audit, recompile guard, alias
+audit, GSPMD audit, symbolic traffic audit) over the package tree plus
+any extra ``paths``. Exit code 0 iff no error-severity findings;
+findings print as ``file:line: [rule] message``.
 
-``--fast`` runs only the AST + VMEM passes (no jax tracing) — what
-``make lint`` and the tier-1 gate use. ``--json`` emits a machine-
-readable summary (the bench leg consumes it).
+``--fast`` runs only the AST + lock-order + VMEM passes (no jax
+tracing) — what ``make lint`` and the tier-1 gate use. ``--json`` emits
+a machine-readable summary line whose ``findings`` key is the full list
+(stable schema: rule, path, line, severity, message) so CI can annotate
+instead of grepping text. ``--suppressions`` prints the suppression
+catalogue (the README block is regenerated from it, drift-tested).
 """
 from __future__ import annotations
 
@@ -24,18 +29,31 @@ def main(argv=None) -> int:
                         help="extra files/dirs to analyze (the package "
                              "tree is always included)")
     parser.add_argument("--fast", action="store_true",
-                        help="AST lint + VMEM budgeter only (no tracing)")
+                        help="AST lint + lock-order + VMEM budgeter only "
+                             "(no tracing)")
     parser.add_argument("--gspmd", action="store_true",
                         help="with --fast: add the GSPMD sharding audit "
                              "(tracing-only, no compilation — what "
                              "`make lint` runs); implied by the full run")
     parser.add_argument("--json", action="store_true",
-                        help="emit a JSON summary line")
+                        help="emit a JSON summary line (findings list + "
+                             "per-pass timings)")
+    parser.add_argument("--suppressions", action="store_true",
+                        help="print the suppression catalogue (markdown "
+                             "rows — the README block regenerates from "
+                             "this) and exit")
     parser.add_argument("--warnings-as-errors", action="store_true")
     args = parser.parse_args(argv)
 
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     paths = [pkg_root] + list(args.paths)
+
+    if args.suppressions:
+        from .findings import suppression_catalogue
+
+        for row in suppression_catalogue(paths):
+            print(row)
+        return 0
 
     if not args.fast or args.gspmd:
         # The traced passes initialize jax: keep tier-1's hermetic-CPU
@@ -49,7 +67,7 @@ def main(argv=None) -> int:
 
     report = run_fast_passes(paths)
     if not args.fast:
-        # The full traced run already folds the gspmd pass in.
+        # The full traced run already folds the gspmd + traffic passes in.
         traced = run_traced_passes(paths)
         report.findings.extend(traced.findings)
         report.pass_seconds.update(traced.pass_seconds)
@@ -61,7 +79,14 @@ def main(argv=None) -> int:
     failing = report.findings if args.warnings_as_errors else report.errors
     if args.json:
         print(json.dumps({
-            "findings": len(report.findings),
+            # Machine-readable findings — the stable schema CI annotates
+            # from (one object per finding, most-severe info inline).
+            "findings": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "severity": f.severity, "message": f.message}
+                for f in sorted(report.findings,
+                                key=lambda f: (f.path, f.line, f.rule))],
+            "n_findings": len(report.findings),
             "errors": len(report.errors),
             "pass_seconds": {k: round(v, 3)
                              for k, v in report.pass_seconds.items()},

@@ -410,11 +410,16 @@ def _lint_class_locks(path: str, cls: ast.ClassDef) -> List[Finding]:
 
 # -- driver -------------------------------------------------------------------
 
-def lint_source(path: str, source: str) -> List[Finding]:
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [Finding("syntax-error", path, e.lineno or 0, str(e.msg))]
+def lint_source(path: str, source: str,
+                tree: Optional[ast.Module] = None) -> List[Finding]:
+    """``tree`` lets run_fast_passes share ONE ast.parse per file across
+    the AST and lock-order passes (parsing dominates both)."""
+    if tree is None:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            return [Finding("syntax-error", path, e.lineno or 0,
+                            str(e.msg))]
     from .retrylint import lint_retry
 
     from .tracelint import lint_trace_calls
@@ -436,7 +441,13 @@ def lint_source(path: str, source: str) -> List[Finding]:
             findings.extend(_lint_class_locks(path, node))
     findings.extend(_lint_module_wide(path, tree, traced))
     findings.extend(lint_retry(path, tree))
-    return apply_suppressions(findings, parse_suppressions(source))
+    out = apply_suppressions(findings, parse_suppressions(source))
+    # After the suppression filter: a bare marker must not vouch for
+    # itself (suppression-policy lint, findings.py).
+    from .findings import lint_suppressions
+
+    out.extend(lint_suppressions(path, source))
+    return out
 
 
 def iter_python_files(paths: Iterable[str]) -> List[str]:

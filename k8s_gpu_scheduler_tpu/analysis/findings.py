@@ -98,6 +98,111 @@ def apply_suppressions(findings: Iterable[Finding],
     return kept
 
 
+# -- suppression policy lint + catalogue --------------------------------------
+
+# The README policy: every suppression carries a rationale in the same
+# comment (or a comment-only line directly above it). "Rationale" = at
+# least this many word characters beyond the marker itself — enough to
+# rule out a marker with no prose (or one decorated only with
+# punctuation) without judging prose quality; a terse-but-real
+# "GIL-atomic" passes.
+_RATIONALE_MIN_WORD_CHARS = 8
+# The marker core alone, for splitting a comment into marker vs prose
+# (the outer _SUPPRESS_RE's leading `#.*?` would swallow prose BEFORE
+# the marker into the match).
+_SUPPRESS_CORE_RE = re.compile(
+    r"graftcheck:\s*ignore(\[(?P<rules>[^\]]*)\])?")
+
+
+def iter_suppression_comments(source: str):
+    """(lineno, rules, rationale) for every suppression comment —
+    ``rules`` is the suppressed set (ALL_RULES for the bare form),
+    ``rationale`` is the comment's remaining prose: the marker comment's
+    own text before/after the marker, falling back to a comment-ONLY
+    line directly above (the idiom for statements whose trailing comment
+    has no room for prose)."""
+    comments: Dict[int, tuple] = {}
+    for lineno, col, text in _iter_comments(source):
+        comments[lineno] = (col, text)
+    lines = source.splitlines()
+
+    def prose_of(text: str) -> str:
+        m = _SUPPRESS_CORE_RE.search(text)
+        rest = (text[:m.start()] + " " + text[m.end():]) if m else text
+        rest = rest.replace("#", " ").strip(" -—:\t")
+        return " ".join(rest.split())
+
+    for lineno in sorted(comments):
+        _col, text = comments[lineno]
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        if m.group("bracket") is None:
+            rules = {ALL_RULES}
+        else:
+            rules = {r.strip() for r in m.group("rules").split(",")
+                     if _RULE_NAME_RE.match(r.strip())}
+            if not rules:
+                continue
+        rationale = prose_of(text)
+        if sum(1 for c in rationale if c.isalnum()) \
+                < _RATIONALE_MIN_WORD_CHARS and lineno - 1 in comments:
+            above_col, above = comments[lineno - 1]
+            above_only = lineno - 2 < len(lines) and not \
+                lines[lineno - 2][:above_col].strip()
+            if above_only and not _SUPPRESS_RE.search(above):
+                rationale = prose_of(above)
+        yield lineno, rules, rationale
+
+
+def lint_suppressions(path: str, source: str) -> List[Finding]:
+    """``bare-suppression``: a suppression marker whose comment carries no
+    rationale. The policy (README "graftcheck") is that every suppression
+    documents WHY where it happens; a bare marker is an exemption nobody
+    can review. NOT itself suppressible — a bare marker cannot vouch for
+    itself."""
+    out: List[Finding] = []
+    for lineno, rules, rationale in iter_suppression_comments(source):
+        word_chars = sum(1 for c in rationale if c.isalnum())
+        if word_chars < _RATIONALE_MIN_WORD_CHARS:
+            what = ("all rules" if ALL_RULES in rules
+                    else ",".join(sorted(rules)))
+            out.append(Finding(
+                "bare-suppression", path, lineno,
+                f"suppression of [{what}] carries no rationale — say WHY "
+                f"in the same comment (policy: README \"graftcheck\"); "
+                f"an exemption nobody can review is how sanctioned "
+                f"suppressions rot into blanket ones"))
+    return out
+
+
+def suppression_catalogue(paths) -> List[str]:
+    """Markdown table rows — one per distinct suppression in ``paths`` —
+    for the README catalogue: ``| file | rules | rationale |`` (no line
+    numbers, so unrelated edits to a file do not churn the docs; adding,
+    removing, or rewording a suppression does). Regenerated from the
+    tree (``python -m k8s_gpu_scheduler_tpu.analysis --suppressions``)
+    and drift-tested, so the catalogue cannot lag the code."""
+    import os
+
+    from .astlint import iter_python_files
+
+    rows: List[str] = []
+    for path in iter_python_files(paths):
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        rel = os.path.relpath(path, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))).replace(
+                os.sep, "/")
+        for _lineno, rules, rationale in iter_suppression_comments(source):
+            what = ("*" if ALL_RULES in rules else ", ".join(
+                f"`{r}`" for r in sorted(rules)))
+            row = f"| `{rel}` | {what} | {rationale or '(none)'} |"
+            if row not in rows:
+                rows.append(row)
+    return sorted(rows)
+
+
 @dataclass
 class Report:
     """Accumulated findings across passes, with per-pass wall time so the

@@ -1,0 +1,416 @@
+"""Symbolic HBM-traffic / residency audit — graftcheck's ninth pass.
+
+Every serving perf claim in this repo is a memory-traffic claim: the
+decode chunk is O(pos), the speculative verify window O(pos+γ), the
+prefix-tail prefill O(hit_len+tail) — and the PR 13 kernel exists
+precisely because the dense prefix gather silently materialized an
+O(L·M·hb·ps) buffer per dispatch, found by eye. This pass makes the
+complexity class a CONTRACT: it traces each registered serving entry
+point (tracing only, no compile), costs every equation's result bytes
+SYMBOLICALLY in the pool geometry dims, and checks the measured scaling
+class against the contract the registry declares for that entry.
+
+Symbolization: the entry's registered ``geometry`` maps symbol names to
+the concrete dim values the audit engines were built with — chosen
+mutually DISTINCT for every scale-bearing dim (pool pages ``n_pages``,
+cache window ``S``, prefix-hit window ``hit`` = hb·ps, tail bucket
+``tb``, verify window ``W`` = 1+γ, slots ``M``) — so a shape like
+``[L, M, hb·ps, Hkv, hd]`` resolves to the monomial ``L·M·hit·Hkv·hd``
+unambiguously. Dims that match no symbol are constants; symbols outside
+the TRACKED set (heads, head_dim, vocab, d_model…) are structural, not
+scale, and are never policed.
+
+Rules:
+
+- ``traffic-contract``: an intermediate's monomial carries a tracked
+  scale symbol beyond the contract's declared class — e.g. anything
+  ``S``-scaled in a prefill rung, an ``S²`` quadratic in a decode chunk,
+  or (island entries) a rank-5 pool value inside a ``shard_map`` whose
+  kv-heads dim is NOT the 1/tp shard — the measured class exceeds the
+  declared one. Also fired, at registry level, when an entry declares NO
+  contract at all: an unstated complexity class cannot regress because
+  it was never stated.
+- ``dense-materialization``: an intermediate that scales with the FULL
+  pool (``n_pages`` with a size blow-up over every pool operand — the
+  update chain pool→pool is exempt, a whole-pool dequant or transpose is
+  not) or with the slots×prefix-window cross product (``M·hit`` — the
+  PR 13 gather class: per-slot dense prefix K/V). The retained gather
+  fallback is the one sanctioned carrier (``dense_ok`` on its contract,
+  with a rationale — the registry-level analogue of a source
+  suppression).
+- ``peak-residency``: donation-aware liveness over the traced program —
+  donated pool operands die at their last use, non-donated ones live to
+  the end — must keep the pool-scale high-water under the contract's
+  declared multiple of the pool working set. Silently-broken donation
+  (the old pool read after the new one exists, or an undonated pool
+  argument) shows up as a 2× pool copy long before an OOM does.
+
+Entry points come from ``entrypoints.traffic_entrypoints()`` with their
+contracts in ``entrypoints.TRAFFIC_CONTRACTS``; out-of-tree code (and
+the seeded ``bad_traffic.py`` fixture) opts in via a module-level
+``GRAFTCHECK_TRAFFIC_AUDIT = [(name, fn, args, geometry, contract), …]``
+hook — ``contract`` a dict of TrafficContract fields, or None to assert
+"this entry must be flagged as contract-less".
+"""
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from .findings import Finding
+
+# Scale symbols the contracts police. Everything else in a geometry is
+# structural vocabulary for readable monomials.
+TRACKED_KV = ("n_pages", "S", "hit", "tb", "W")
+# The pool-pages symbol: monomials containing it are pool-scale.
+POOL_SYM = "n_pages"
+# The slots symbol (with `hit` it forms the dense-prefix cross product).
+SLOTS_SYM = "M"
+HIT_SYM = "hit"
+
+
+@dataclass(frozen=True)
+class TrafficContract:
+    """Declared per-dispatch traffic class for one entry point.
+
+    ``kv_scale`` maps tracked symbols to the maximum POWER an
+    intermediate may carry them at (absent = 0): decode declares
+    ``{"S": 1}`` (O(pos), pos ≤ S), verify ``{"S": 1, "W": 2}``, a
+    prefix-tail prefill rung ``{"tb": 2}`` (the tail attends itself
+    causally) with ``"hit": 1`` only on the gather fallback.
+    ``dense_ok`` sanctions ``dense-materialization`` findings (the
+    gather fallback) and requires a ``rationale``. ``donated`` are the
+    entry's donated argument positions (the recompile pass verifies them
+    dynamically; here they drive the liveness analysis).
+    ``residency_multiple`` bounds peak pool-scale live bytes as a
+    multiple of the pool working set (None skips the residency check).
+    ``tp`` > 1 marks an island entry: rank-5 pool values inside its
+    shard_map must carry the kv-heads dim at 1/tp."""
+    kv_scale: Mapping[str, int] = field(default_factory=dict)
+    dense_ok: bool = False
+    rationale: str = ""
+    donated: Tuple[int, ...] = ()
+    residency_multiple: Optional[float] = 1.25
+    tp: int = 1
+
+    def __post_init__(self):
+        if self.dense_ok and not self.rationale.strip():
+            raise ValueError(
+                "dense_ok=True requires a rationale — a sanctioned dense "
+                "materialization is a reviewable exemption, not a default")
+        unknown = set(self.kv_scale) - set(TRACKED_KV)
+        if unknown:
+            raise ValueError(
+                f"kv_scale names untracked symbols {sorted(unknown)} "
+                f"(tracked: {TRACKED_KV})")
+
+
+# -- symbolic shapes ----------------------------------------------------------
+
+def symbolize_shape(shape: Sequence[int], geometry: Mapping[str, int],
+                    ) -> Tuple[Counter, int]:
+    """(symbol multiset, constant factor) for a concrete shape. First
+    geometry entry with a matching value wins — the registry orders
+    scale symbols first and builds its audit engines with DISTINCT
+    values for them, so the mapping is unambiguous where it matters."""
+    syms: Counter = Counter()
+    const = 1
+    for d in shape:
+        d = int(d)
+        for name, val in geometry.items():
+            if val == d and d != 1:
+                syms[name] += 1
+                break
+        else:
+            const *= d
+    return syms, const
+
+
+def render_monomial(syms: Counter, const: int) -> str:
+    parts = [f"{s}^{p}" if p > 1 else s
+             for s, p in sorted(syms.items())]
+    if const != 1 or not parts:
+        parts.append(str(const))
+    return "·".join(parts)
+
+
+def _aval_bytes(aval) -> int:
+    size = getattr(aval, "size", 0)
+    dtype = getattr(aval, "dtype", None)
+    return int(size) * (dtype.itemsize if dtype is not None else 0)
+
+
+def _iter_subjaxprs(params: dict):
+    import jax.core as jc
+
+    for key, val in params.items():
+        vals = val if isinstance(val, (tuple, list)) else [val]
+        for v in vals:
+            if isinstance(v, jc.ClosedJaxpr):
+                yield key, v.jaxpr
+            elif isinstance(v, jc.Jaxpr):
+                yield key, v
+
+
+# Primitives-with-one-body wrappers make_jaxpr leaves around a jitted fn.
+_WRAPPER_PRIMS = {"pjit", "closed_call", "core_call", "xla_call",
+                  "custom_jvp_call", "custom_vjp_call", "remat", "checkpoint"}
+
+
+def _unwrap(jaxpr, donated_vars: set):
+    """Descend through single-eqn wrapper jaxprs (the pjit shell around
+    a jitted entry), mapping donated invars through by identity, until a
+    jaxpr with real equations is reached."""
+    while len(jaxpr.eqns) == 1 \
+            and jaxpr.eqns[0].primitive.name in _WRAPPER_PRIMS:
+        eqn = jaxpr.eqns[0]
+        subs = [j for _k, j in _iter_subjaxprs(eqn.params)]
+        if len(subs) != 1:
+            break
+        inner = subs[0]
+        if len(inner.invars) != len(eqn.invars):
+            break
+        donated_vars = {iv for iv, ov in zip(inner.invars, eqn.invars)
+                        if ov in donated_vars}
+        jaxpr = inner
+    return jaxpr, donated_vars
+
+
+# -- the audit ----------------------------------------------------------------
+
+def audit_traffic_jaxpr(closed, name: str, geometry: Mapping[str, int],
+                        contract: TrafficContract,
+                        donated_invars: Optional[set] = None,
+                        ) -> List[Finding]:
+    """Audit one ClosedJaxpr against its traffic contract.
+    ``donated_invars``: the set of top-level invar VARS whose buffers the
+    caller donates (computed by audit_traffic_callable from
+    ``contract.donated`` and the argument tree structure)."""
+    import jax.core as jc
+
+    anchor = f"<traffic:{name}>"
+    findings: List[Finding] = []
+    seen: set = set()          # (rule, monomial) — dedupe per-layer repeats
+
+    def emit(rule: str, key: str, msg: str, severity: str = "error"):
+        if (rule, key) in seen:
+            return
+        seen.add((rule, key))
+        findings.append(Finding(rule, anchor, 0, msg, severity=severity))
+
+    def classify_out(eqn, var, in_island: bool) -> None:
+        aval = getattr(var, "aval", None)
+        shape = getattr(aval, "shape", None)
+        if shape is None or len(shape) < 2:
+            return
+        syms, const = symbolize_shape(shape, geometry)
+        mono = render_monomial(syms, const)
+        if POOL_SYM in syms:
+            # Pool-scale value: exempt iff it is the pool UPDATE chain —
+            # some operand is pool-scale and at least as big in bytes
+            # (scatter/select/stack of the pool into the pool). A
+            # whole-pool dequant (int8→f32: 4× bytes, same monomial) or
+            # a pool-scale buffer born from nothing is a dense
+            # materialization of the full pool.
+            out_bytes = _aval_bytes(aval)
+            chain = any(
+                POOL_SYM in symbolize_shape(
+                    getattr(getattr(iv, "aval", None), "shape", ()) or (),
+                    geometry)[0]
+                and _aval_bytes(iv.aval) >= out_bytes
+                for iv in eqn.invars
+                if not isinstance(iv, jc.Literal)
+                and hasattr(getattr(iv, "aval", None), "shape"))
+            if not chain and not contract.dense_ok:
+                emit("dense-materialization", mono,
+                     f"{name}: {eqn.primitive.name} materializes a "
+                     f"pool-scale intermediate {tuple(shape)} "
+                     f"[{mono}] that is not the pool update chain — "
+                     f"full-pool traffic on every dispatch (the class "
+                     f"the paged kernels exist to avoid)")
+            return                       # pool chain: not policed further
+        if SLOTS_SYM in syms and HIT_SYM in syms and not contract.dense_ok:
+            emit("dense-materialization", mono,
+                 f"{name}: {eqn.primitive.name} materializes "
+                 f"{tuple(shape)} [{mono}] — the slots×prefix-window "
+                 f"cross product (dense per-slot prefix K/V, the PR 13 "
+                 f"gather class); stream the prefix through the kernel "
+                 f"table indirection instead, or sanction the fallback "
+                 f"in its contract")
+        for sym in TRACKED_KV:
+            power = syms.get(sym, 0)
+            allowed = contract.kv_scale.get(sym, 0)
+            if power > allowed:
+                emit("traffic-contract", f"{sym}:{mono}",
+                     f"{name}: intermediate {tuple(shape)} [{mono}] "
+                     f"scales with {sym}^{power}, contract allows "
+                     f"{sym}^{allowed} — measured traffic class exceeds "
+                     f"the declared one "
+                     f"(allowed: {dict(contract.kv_scale) or 'none'})")
+
+    def check_island_pool(jaxpr) -> None:
+        hkv = geometry.get("Hkv")
+        if contract.tp <= 1 or not hkv:
+            return
+        vals = list(jaxpr.invars)
+        for eqn in jaxpr.eqns:
+            vals.extend(v for v in eqn.outvars
+                        if not isinstance(v, jc.DropVar))
+        for v in vals:
+            shape = getattr(getattr(v, "aval", None), "shape", None)
+            if shape is None or len(shape) != 5:
+                continue
+            syms, _ = symbolize_shape(shape, geometry)
+            if POOL_SYM in syms and int(shape[3]) * contract.tp != hkv:
+                emit("traffic-contract", f"island:{tuple(shape)}",
+                     f"{name}: rank-5 pool value {tuple(shape)} inside "
+                     f"the tp={contract.tp} island carries kv-heads dim "
+                     f"{int(shape[3])}, expected Hkv/tp = "
+                     f"{hkv // contract.tp} — the island moves full "
+                     f"pool-dim traffic instead of 1/tp per chip")
+
+    def visit(jaxpr, in_island: bool) -> None:
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            for var in eqn.outvars:
+                if not isinstance(var, jc.DropVar):
+                    classify_out(eqn, var, in_island)
+            for _key, sub in _iter_subjaxprs(eqn.params):
+                if prim == "shard_map":
+                    check_island_pool(sub)
+                visit(sub, in_island or prim == "shard_map")
+
+    top, donated = _unwrap(closed.jaxpr, set(donated_invars or ()))
+    visit(top, in_island=False)
+    if contract.tp > 1 and not any(
+            eqn.primitive.name == "shard_map"
+            for j in _all_jaxprs(top) for eqn in j.eqns):
+        emit("traffic-contract", "island-missing",
+             f"{name}: contract declares tp={contract.tp} but the traced "
+             f"program contains no shard_map island — pool traffic is "
+             f"not sharded at all")
+
+    findings.extend(_check_residency(top, name, geometry, contract,
+                                     donated))
+    return findings
+
+
+def _all_jaxprs(jaxpr):
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for _k, sub in _iter_subjaxprs(eqn.params):
+            yield from _all_jaxprs(sub)
+
+
+def _check_residency(jaxpr, name: str, geometry: Mapping[str, int],
+                     contract: TrafficContract,
+                     donated_vars: set) -> List[Finding]:
+    """Donation-aware liveness over the (unwrapped) top-level equation
+    schedule: pool-scale values live from definition to last use —
+    donated invars die at their last use, non-donated invars live for
+    the whole program (the caller retains them), program outputs live to
+    the end. The high-water of live pool-scale bytes must stay under
+    ``residency_multiple`` × the pool working set."""
+    import jax.core as jc
+
+    anchor = f"<traffic:{name}>"
+    if contract.residency_multiple is None:
+        return []
+
+    def pool_bytes(v) -> int:
+        aval = getattr(v, "aval", None)
+        shape = getattr(aval, "shape", None)
+        if shape is None:
+            return 0
+        syms, _ = symbolize_shape(shape, geometry)
+        return _aval_bytes(aval) if POOL_SYM in syms else 0
+
+    pool_set = sum(pool_bytes(v) for v in jaxpr.invars)
+    if pool_set == 0:
+        return [Finding(
+            "traffic-contract", anchor, 0,
+            f"{name}: no pool-scale ({POOL_SYM}-dim) operands found — "
+            f"the residency audit is vacuous; the geometry mapping has "
+            f"drifted from the entry's real shapes", severity="warning")]
+
+    n = len(jaxpr.eqns)
+    defined_at: Dict[int, int] = {}     # id(var) -> eqn index (invar: -1)
+    last_use: Dict[int, int] = {}
+    tracked: Dict[int, int] = {}        # id(var) -> pool bytes
+    for v in jaxpr.invars:
+        b = pool_bytes(v)
+        if b:
+            tracked[id(v)] = b
+            defined_at[id(v)] = -1
+            # Non-donated operands stay live for the whole program.
+            last_use[id(v)] = last_use.get(id(v), -1) if v in donated_vars \
+                else n
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if isinstance(v, jc.Literal):
+                continue
+            if id(v) in tracked:
+                if v in donated_vars:
+                    last_use[id(v)] = max(last_use.get(id(v), -1), i)
+                # else: pinned to n already
+        for v in eqn.outvars:
+            if isinstance(v, jc.DropVar):
+                continue
+            b = pool_bytes(v)
+            if b:
+                tracked[id(v)] = b
+                defined_at[id(v)] = i
+                last_use.setdefault(id(v), i)
+        # Intermediate uses extend liveness of non-invar pool values.
+        for v in eqn.invars:
+            if isinstance(v, jc.Literal):
+                continue
+            if id(v) in tracked and defined_at.get(id(v), -1) >= 0:
+                last_use[id(v)] = max(last_use.get(id(v), -1), i)
+    for v in jaxpr.outvars:
+        if id(v) in tracked:
+            last_use[id(v)] = n
+
+    peak, peak_at = 0, -1
+    for t in range(-1, n):
+        live = sum(b for vid, b in tracked.items()
+                   if defined_at.get(vid, -1) <= t < last_use.get(vid, -1))
+        if live > peak:
+            peak, peak_at = live, t
+    limit = contract.residency_multiple * pool_set
+    if peak > limit:
+        return [Finding(
+            "peak-residency", anchor, 0,
+            f"{name}: pool-scale live bytes peak at {peak} "
+            f"({peak / pool_set:.2f}× the {pool_set}-byte pool working "
+            f"set, after eqn {peak_at}) > declared "
+            f"{contract.residency_multiple}× — donation is broken or "
+            f"the program copies the pool; at real scale this is a "
+            f"2×-pool HBM spike per dispatch")]
+    return []
+
+
+def audit_traffic_callable(fn, args: Sequence, name: str,
+                           geometry: Mapping[str, int],
+                           contract: TrafficContract) -> List[Finding]:
+    """Trace ``fn(*args)`` and audit it against ``contract``. Tracing
+    failures become findings so one broken entry cannot hide the rest."""
+    import jax
+
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # noqa: BLE001 — report, keep auditing
+        return [Finding("traffic-trace-error", f"<traffic:{name}>", 0,
+                        f"could not trace {name}: {type(e).__name__}: "
+                        f"{str(e)[:300]}")]
+    donated = set()
+    offset = 0
+    leaves_per_arg = [len(jax.tree_util.tree_leaves(a)) for a in args]
+    invars = list(closed.jaxpr.invars)
+    for pos, nleaves in enumerate(leaves_per_arg):
+        if pos in contract.donated:
+            donated.update(invars[offset:offset + nleaves])
+        offset += nleaves
+    return audit_traffic_jaxpr(closed, name, geometry, contract,
+                               donated_invars=donated)

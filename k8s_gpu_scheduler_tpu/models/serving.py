@@ -2981,7 +2981,9 @@ class ContinuousBatcher:
             idx = np.asarray(ids, np.int32)
             # graftcheck: ignore[host-sync] — sanctioned: the drain IS the readback (one gather of live+cached pages per preemption)
             gathered = jax.device_get(
+                # graftcheck: ignore[use-after-donate] — sanctioned: drain runs at a step boundary (admission stopped, readbacks flushed), so the pool is the COMMITTED post-dispatch array; no step can race this read
                 [self._k[:, idx], self._v[:, idx]]
+                # graftcheck: ignore[use-after-donate] — sanctioned: same step-boundary contract (scale planes)
                 + ([self._ks[:, idx], self._vs[:, idx]]
                    if self._ks is not None else []))
         else:

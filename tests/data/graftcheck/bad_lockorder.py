@@ -1,0 +1,76 @@
+"""Seeded-bad fixture: lock-order / donated-buffer concurrency true
+positives (analysis/lockorder.py — plain AST, no hook protocol: the
+pass lints any scanned source).
+
+- ``BadLockOrder.ab``/``ba`` acquire the same two locks in OPPOSITE
+  orders (``lock-cycle`` — two threads entering from different edges
+  deadlock), and ``reenter`` re-acquires a non-reentrant Lock it
+  already holds (the degenerate self-cycle);
+- ``BadLockOrder.scrape`` drains two guarded gauges under two SEPARATE
+  acquisitions of the same lock (``torn-snapshot`` — the values come
+  from different instants);
+- ``BadDonatedScrape.metrics`` reads an attr that aliases a
+  per-dispatch-donated device array from outside the step path
+  (``use-after-donate`` — the pool_metrics scrape-race class);
+- the bare marker below carries no rationale (``bare-suppression``).
+"""
+import threading
+
+import jax
+
+
+class BadLockOrder:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._stats = {}
+        self._hist = []
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                self._stats["x"] = 1
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                self._stats["y"] = 2
+
+    def _bump(self):
+        with self._a:
+            self._hist.append(1)
+
+    def reenter(self):
+        with self._a:
+            self._bump()               # re-acquires self._a: self-deadlock
+
+    def scrape(self):
+        out = {}
+        with self._a:
+            out["stats"] = dict(self._stats)
+        # Torn: a writer between the two acquisitions pairs this
+        # instant's stats with the next instant's hist.
+        with self._a:
+            out["hist"] = list(self._hist)
+        return out
+
+
+def _step(pool, x):
+    return (pool + x,)
+
+
+class BadDonatedScrape:
+    def __init__(self, pool):
+        self._pool = pool
+        self._step_fn = jax.jit(_step, donate_argnums=(0,))
+
+    def step(self, x):
+        # The step path: dispatch consumes the pool, rebinds the result.
+        self._pool, = self._step_fn(self._pool, x)
+
+    def metrics(self):
+        # A scrape thread racing step() reads a DELETED buffer and dies;
+        # the blank line below keeps the bare marker genuinely bare.
+
+        probe = float(self._pool[0, 0])  # graftcheck: ignore[host-sync]
+        return {"probe": probe}

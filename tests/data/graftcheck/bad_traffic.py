@@ -1,0 +1,60 @@
+"""Seeded-bad fixture: symbolic traffic-contract true positives.
+
+Three toy entry points for the traffic audit (analysis/traffic.py),
+each wrong in exactly the way the pass exists to catch — none of them
+produces wrong numbers, all of them silently burn HBM bandwidth or
+residency at scale, and none is visible to the AST or recompile passes:
+
+- ``dense_gather`` materializes the slots×prefix-window cross product
+  ``[L, M, hb·ps, Hkv, hd]`` out of the page pool — the PR 13 prefill
+  gather class (``dense-materialization``) — under a contract that
+  declares no ``hit`` scaling (``traffic-contract``);
+- ``broken_donation`` reads the OLD pool after the updated pool exists,
+  so even with the argument declared donated the old buffer must
+  survive the update — a 2× pool high-water (``peak-residency``), the
+  silently-broken-donation shape;
+- ``no_contract`` registers with ``None`` — a serving-shaped entry
+  whose complexity class was never declared (``traffic-contract``).
+
+Geometry values are mutually distinct for every scale symbol, per the
+registry convention (TRAFFIC_GEOMETRY).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+L, N_PAGES, PS, HKV, HD = 2, 11, 4, 3, 7
+M, HB = 5, 2
+HIT = HB * PS                              # 8
+
+GEOMETRY = {"n_pages": N_PAGES, "hit": HIT, "M": M,
+            "L": L, "Hkv": HKV, "hd": HD, "ps": PS}
+
+_POOL = jnp.zeros((L, N_PAGES, PS, HKV, HD), jnp.float32)
+_TBL = np.tile(np.asarray([[1, 2]], np.int32), (M, 1))    # [M, HB]
+_ROW = jnp.ones((PS, HKV, HD), jnp.float32)
+
+
+def _dense_gather(pool, tbl):
+    got = pool[:, tbl]                     # [L, M, HB, PS, HKV, HD]
+    got = got.reshape(L, M, HIT, HKV, HD)  # the dense per-slot prefix
+    return got.sum()
+
+
+def _broken_donation(pool, row):
+    new = pool.at[:, 1].set(row)
+    # The old pool is read AFTER its replacement exists: donation cannot
+    # reuse the buffer, so both copies are live at once.
+    return new, pool.sum()
+
+
+def _no_contract(pool):
+    return pool.sum()
+
+
+GRAFTCHECK_TRAFFIC_AUDIT = [
+    ("bad_dense_gather", _dense_gather, (_POOL, _TBL), GEOMETRY,
+     {"kv_scale": {"tb": 1}, "donated": (0,)}),
+    ("bad_broken_donation", _broken_donation, (_POOL, _ROW), GEOMETRY,
+     {"kv_scale": {}, "donated": (0,)}),
+    ("bad_no_contract", _no_contract, (_POOL,), GEOMETRY, None),
+]

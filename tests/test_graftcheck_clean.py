@@ -1,11 +1,12 @@
 """Tier-1 gate: the tree must stay graftcheck-clean.
 
-Runs the FAST passes (AST lint + VMEM budgeter — no tracing, ~2 s) over
-the package exactly as ``make lint`` does, and fails with the rendered
-``file:line: [rule] message`` list if anything regressed. The traced
-passes (jaxpr audit, recompile guard) have their own tests in
-tests/test_analysis.py; the full four-pass run is
-``python -m k8s_gpu_scheduler_tpu.analysis``.
+Runs the FAST passes (AST lint incl. retry/trace/suppression lints, the
+lock-order & donated-buffer audit, VMEM budgeter — no tracing, ~4 s)
+over the package exactly as ``make lint`` does, and fails with the
+rendered ``file:line: [rule] message`` list if anything regressed. The
+traced passes (jaxpr audit, recompile guard, alias, gspmd, symbolic
+traffic) have their own tests in tests/test_analysis.py; the full
+ten-pass run is ``python -m k8s_gpu_scheduler_tpu.analysis``.
 
 Suppression policy: ``# graftcheck: ignore[rule]`` with a rationale in
 the surrounding comment (see README "graftcheck").
