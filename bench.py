@@ -2321,6 +2321,7 @@ def bench_sharded_decode(smoke=False, tp=2):
     pm = e2.pool_metrics()
     bytes_tp = pm["kv_pool_device_bytes"]
 
+    pm1 = e1.pool_metrics()
     extra = {
         "sharded_interpret": not on_tpu,
         "sharded_tp": tp,
@@ -2334,10 +2335,157 @@ def bench_sharded_decode(smoke=False, tp=2):
         "sharded_pool_bytes_scaled": int(bytes_tp) * tp == int(bytes_1),
         "sharded_tok_s_tp1": round(tok_s_1, 1),
         f"sharded_tok_s_tp{tp}": round(tok_s_tp, 1),
+        # Megatron-sliced weights (PR 15, the sharded_weights leg's
+        # deep-dive rows folded into this table): the tp engine above
+        # runs the weight-sharded default, so its per-chip weight
+        # residency rides here too — the sliced subset is exactly 1/tp.
+        "sharded_weight_bytes_per_chip": int(pm["weight_device_bytes"]),
+        "sharded_weight_sliced_scaled":
+            int(pm["weight_sliced_device_bytes"]) * tp
+            == int(pm1["weight_sliced_device_bytes"]),
+        "sharded_tp_combine": pm["tp_combine"],
     }
     return {
         "metric": "sharded_decode_tok_s",
         "value": round(tok_s_tp, 1),
+        "unit": "tok/s",
+        "extra": extra,
+    }
+
+
+def bench_sharded_weights(smoke=False, tp=2):
+    """Megatron-sliced weights through the tp islands (PR 15) on FORCED
+    host devices: the same open-loop workload through four engines —
+    unsharded (tp=1), weight-sharded tp=N with the all_gather combine
+    (the default: movement-only, byte-identical), weight-sharded tp=N
+    with the psum combine (1/tp row-matmul FLOPs, tolerance-checked),
+    and the LEGACY replicated-weight island (weight_sharding=False) —
+    CI-asserting the whole contract: all_gather streams byte-identical
+    to tp=1 AND to the replicated island, per-chip bytes of the
+    WEIGHT_SPECS-sliced weight leaves exactly 1/tp, total per-chip
+    weight residency strictly below replicated, zero retrace across the
+    measured steady state with pool + scales + table donated, and tok/s
+    for every engine so the combine overhead stays visible run over
+    run. On CPU the tok/s deltas are emulation noise — only the
+    invariants are asserted."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = ((flags + " ") if flags else "") + \
+            f"--xla_force_host_platform_device_count={2 * tp}"
+    import dataclasses
+    import warnings
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from k8s_gpu_scheduler_tpu.analysis.recompile import RecompileGuard
+    from k8s_gpu_scheduler_tpu.models.llama import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+    if len(jax.devices()) < tp:
+        return {"metric": "sharded_weights_tok_s", "value": 0.0,
+                "unit": "tok/s",
+                "extra": {"wsharded_error":
+                          f"need {tp} devices, have {len(jax.devices())}"}}
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny() if not on_tpu or smoke else LlamaConfig(
+            vocab=32000, d_model=1024, n_layers=4, n_heads=16,
+            n_kv_heads=8, d_ff=2816, max_seq=2048, remat=False),
+        decode_attn="fused")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    max_len, page = (64, 8) if not on_tpu or smoke else (1024, 64)
+    n_req, max_new = (10, 8) if smoke else (24, 16)
+
+    def build(mesh, **kw):
+        return ContinuousBatcher(
+            params, cfg, n_slots=4, max_len=max_len, chunk=4,
+            prefill_bucket=2 * page, kv_dtype="int8", kv_layout="paged",
+            page_size=page, mesh=mesh, **kw)
+
+    def drive(eng, measure=False):
+        rng = np.random.default_rng(7)
+        t0 = time.perf_counter()
+        out = {}
+        guard = None
+        for wave in range(3):
+            for _ in range(n_req // 3):
+                eng.submit(rng.integers(0, cfg.vocab, int(
+                    rng.integers(page // 2, 3 * page))), max_new=max_new)
+            out.update(eng.run())
+            if measure and wave == 0 and guard is None:
+                guard = RecompileGuard()
+                guard.track("decode", eng._decode)
+                guard.track("prefill", eng._prefill)
+                guard.snapshot()
+        wall = time.perf_counter() - t0
+        toks = sum(len(v) for v in out.values())
+        misses = guard.misses_since() if guard else {}
+        return out, toks / wall, misses
+
+    e1 = build(None)
+    ref, tok_s_1, _ = drive(e1)
+    pm1 = e1.pool_metrics()
+
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+    eag = build(mesh)                                # the default
+    got_ag, tok_s_ag, misses = drive(eag, measure=True)
+    pm_ag = eag.pool_metrics()
+
+    eps_ = build(mesh, tp_combine="psum")
+    got_ps, tok_s_ps, _ = drive(eps_)
+    pm_ps = eps_.pool_metrics()
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        erep = build(mesh, weight_sharding=False)
+    got_rep, tok_s_rep, _ = drive(erep)
+    pm_rep = erep.pool_metrics()
+
+    extra = {
+        "wsharded_interpret": not on_tpu,
+        "wsharded_tp": tp,
+        # all_gather: byte-pinned against BOTH references.
+        "wsharded_token_identity": got_ag == ref,
+        "wsharded_identity_vs_replicated": got_ag == got_rep,
+        # psum: tolerance-checked by contract, NOT byte-pinned — a
+        # logit near-tie can flip an argmax under the changed reduction
+        # order, so the identity bit is a REPORTED fact while CI
+        # asserts the agreement FLOOR (near-ties are rare: ≥ 0.8 of
+        # streams byte-match on any trace; the numeric bound itself is
+        # test-pinned in test_sharded_serving).
+        "wsharded_psum_token_identity": got_ps == ref,
+        "wsharded_psum_stream_agreement": round(
+            sum(got_ps[r] == ref[r] for r in ref) / max(1, len(ref)), 3),
+        "wsharded_zero_retrace": not any(misses.values()),
+        "wsharded_retraces": {k: int(v) for k, v in misses.items()},
+        "wsharded_sliced_bytes_tp1":
+            int(pm1["weight_sliced_device_bytes"]),
+        "wsharded_sliced_bytes_per_chip":
+            int(pm_ag["weight_sliced_device_bytes"]),
+        # Exact 1/tp on the WEIGHT_SPECS-sliced subset (no padding —
+        # divisibility is an __init__ invariant); total per-chip
+        # residency strictly below the replicated island's.
+        "wsharded_sliced_bytes_scaled":
+            int(pm_ag["weight_sliced_device_bytes"]) * tp
+            == int(pm1["weight_sliced_device_bytes"]),
+        "wsharded_total_bytes_per_chip": int(pm_ag["weight_device_bytes"]),
+        "wsharded_total_below_replicated":
+            pm_ag["weight_device_bytes"] < pm_rep["weight_device_bytes"],
+        "wsharded_psum_bytes_match":
+            pm_ps["weight_device_bytes"] == pm_ag["weight_device_bytes"],
+        "wsharded_combines": [pm_ag["tp_combine"], pm_ps["tp_combine"],
+                              pm_rep["tp_combine"]],
+        "wsharded_tok_s_tp1": round(tok_s_1, 1),
+        f"wsharded_tok_s_tp{tp}_all_gather": round(tok_s_ag, 1),
+        f"wsharded_tok_s_tp{tp}_psum": round(tok_s_ps, 1),
+        f"wsharded_tok_s_tp{tp}_replicated": round(tok_s_rep, 1),
+    }
+    return {
+        "metric": "sharded_weights_tok_s",
+        "value": round(tok_s_ag, 1),
         "unit": "tok/s",
         "extra": extra,
     }
@@ -2386,6 +2534,9 @@ def main(argv=None):
         if leg == "sharded_decode":
             print(json.dumps(bench_sharded_decode(smoke="--smoke" in args)))
             return
+        if leg == "sharded_weights":
+            print(json.dumps(bench_sharded_weights(smoke="--smoke" in args)))
+            return
         if leg == "multiturn":
             print(json.dumps(bench_multiturn(smoke="--smoke" in args)))
             return
@@ -2393,7 +2544,7 @@ def main(argv=None):
                          f"decode_attention, paged_attention, prefix_cache, "
                          f"speculative, analysis, chaos, obs_overhead, "
                          f"fleet, fleet_chaos, chunked_prefill, "
-                         f"sharded_decode, multiturn)")
+                         f"sharded_decode, sharded_weights, multiturn)")
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
     # the bench measures the scheduler as deployed.
     sys.setswitchinterval(0.001)

@@ -184,9 +184,16 @@ def jaxpr_entrypoints() -> List[Tuple[str, Callable, tuple]]:
 
 # The audit engines' geometry, one shared table: every SCALE-bearing dim
 # (what the traffic contracts police) has a value distinct from every
-# other dim in play, so a concrete shape resolves to one monomial.
-# Structural dims (heads, head_dim, page size…) may collide — they are
-# vocabulary, never policed. Order is resolution priority.
+# other dim in play — INCLUDING the tp-sliced widths the weight-sharded
+# islands introduce (d/tp = 48, d_ff/tp = 80, which is why the audit
+# config is d_model=96/d_ff=160 rather than tiny's 64/128: tiny's
+# sliced q width 64/2 = 32 collides with the `hit` symbol and every
+# local projection would read as hit-scaled) — so a concrete shape
+# resolves to one monomial. Structural dims (heads, head_dim, page
+# size…) may collide — they are vocabulary, never policed. Order is
+# resolution priority. `d` and `d_ff` double as the FULL-weight dims
+# the replicated-weight island check (analysis/traffic.py
+# weight_sharded contracts) matches [L, K, N] island invars against.
 TRAFFIC_GEOMETRY: Dict[str, int] = {
     "n_pages": 23,     # pool pages (explicit, not the 1+M·n_blocks default)
     "S": 56,           # max_len (the contiguous window / O(pos) bound)
@@ -194,9 +201,19 @@ TRAFFIC_GEOMETRY: Dict[str, int] = {
     "tb": 16,          # tail bucket
     "W": 5,            # 1+gamma verify window (gamma=4)
     "M": 3,            # slots
-    "L": 2, "vocab": 256, "d_ff": 128, "d": 64,
-    "Hkv": 8, "hd": 8, "ps": 8,
+    "L": 2, "vocab": 256, "d_ff": 160, "d": 96,
+    "Hkv": 8, "hd": 12, "ps": 8,
 }
+
+
+def _traffic_cfg():
+    """The traffic-audit model config — tiny-scale but with d_model/d_ff
+    chosen so every dim in play (full AND tp-sliced) resolves to one
+    geometry symbol (see TRAFFIC_GEOMETRY's comment)."""
+    from ..models.llama import LlamaConfig
+
+    return LlamaConfig(vocab=256, d_model=96, n_layers=2, n_heads=8,
+                       n_kv_heads=8, d_ff=160, max_seq=128, remat=False)
 
 
 def traffic_contracts() -> Dict[str, "object"]:
@@ -230,33 +247,80 @@ def traffic_contracts() -> Dict[str, "object"]:
                       "plan-rejected rungs — counted, never silent",
             donated=(1, 2, 3, 4)),
         # tp-island variants: same classes, plus the 1/tp pool-dim check
-        # (rank-5 pool values inside the island carry Hkv/tp).
+        # (rank-5 pool values inside the island carry Hkv/tp) and — for
+        # weight_sharded entries — the replicated-weight check: every
+        # [L, K, N] weight INVAR of the island must carry a sliced dim;
+        # a full (d, d)/(d, ffn)/(ffn, d) weight operand is the
+        # replicated layout this PR retires, flagged as a
+        # traffic-contract finding. One row per sharded-weight dispatch
+        # class: decode (both combines), verify, and every prefill rung
+        # family member (hb0 / hb4-kernel / hb4-gather).
         "traffic_decode_chunk_tp2": TrafficContract(
-            kv_scale={"S": 1}, donated=(1, 2, 3, 4, 5), tp=2),
+            kv_scale={"S": 1}, donated=(1, 2, 3, 4, 5), tp=2,
+            weight_sharded=True),
+        "traffic_decode_chunk_tp2_psum": TrafficContract(
+            kv_scale={"S": 1}, donated=(1, 2, 3, 4, 5), tp=2,
+            weight_sharded=True),
+        "traffic_verify_window_tp2": TrafficContract(
+            kv_scale={"S": 1, "W": 2}, donated=(1, 2, 3, 4, 5), tp=2,
+            weight_sharded=True),
+        "traffic_prefill_tb16_hb0_tp2": TrafficContract(
+            kv_scale={"tb": 2}, donated=(1, 2, 3, 4), tp=2,
+            weight_sharded=True),
         "traffic_prefill_tb16_hb4_kernel_tp2": TrafficContract(
-            kv_scale={"tb": 2}, donated=(1, 2, 3, 4), tp=2),
+            kv_scale={"tb": 2}, donated=(1, 2, 3, 4), tp=2,
+            weight_sharded=True),
+        "traffic_prefill_tb16_hb4_gather_tp2": TrafficContract(
+            kv_scale={"tb": 2, "hit": 1}, dense_ok=True,
+            rationale="retained dense-gather fallback (see the non-tp "
+                      "row) — the island edition carries the same "
+                      "sanction",
+            donated=(1, 2, 3, 4), tp=2, weight_sharded=True),
+        # The LEGACY replicated-weight island (weight_sharding=False)
+        # keeps a contract row of its own: same traffic classes, NO
+        # weight_sharded check — and the tests pin that auditing it
+        # UNDER a weight_sharded contract trips the replicated-weight
+        # finding (the silent-downgrade class, made loud).
+        "traffic_decode_chunk_tp2_replicated": TrafficContract(
+            kv_scale={"S": 1}, donated=(1, 2, 3, 4, 5), tp=2),
     }
 
 
 def _traffic_engine(speculative: bool = False,
-                    prefill_attn=None, tp: bool = False):
+                    prefill_attn=None, tp: bool = False,
+                    weight_sharding: bool = True,
+                    tp_combine: str = "all_gather"):
     """A paged audit engine at the TRAFFIC_GEOMETRY shapes (fused decode,
-    int8 KV — every operand class in play)."""
+    int8 KV — every operand class in play). tp entries default to the
+    runtime default — Megatron-sliced weights, all_gather combine —
+    with knobs so the psum-combine and legacy replicated-weight islands
+    get their own contract rows."""
     import dataclasses
 
-    from ..models import serving
+    import jax
 
-    cfg, params = _tiny()
+    from ..models import serving
+    from ..models.llama import init_params
+
+    cfg = dataclasses.replace(_traffic_cfg(), decode_attn="fused")
+    params = init_params(cfg, jax.random.PRNGKey(0))
     kw: dict = {}
     if speculative:
         kw.update(speculative=True, gamma=4)
     if tp:
-        kw.update(mesh=_audit_mesh())
-    return serving.ContinuousBatcher(
-        params, dataclasses.replace(cfg, decode_attn="fused"), n_slots=3,
-        max_len=56, chunk=2, prefill_bucket=16, kv_dtype="int8",
-        kv_layout="paged", page_size=8, n_pages=23,
-        prefill_attn=prefill_attn, **kw)
+        kw.update(mesh=_audit_mesh(), weight_sharding=weight_sharding,
+                  tp_combine=tp_combine)
+    # The legacy replicated-weight engine is built DELIBERATELY here
+    # (its contract row is the audit's subject): neither warn nor
+    # count — the suppression restores the warn-once/counter state so
+    # the first REAL engine still warns and the production metric
+    # stays clean of audit throwaways.
+    with serving.fallback_notes_suppressed("weights_replicated"):
+        return serving.ContinuousBatcher(
+            params, cfg, n_slots=3,
+            max_len=56, chunk=2, prefill_bucket=16, kv_dtype="int8",
+            kv_layout="paged", page_size=8, n_pages=23,
+            prefill_attn=prefill_attn, **kw)
 
 
 # THE single source of the traffic registry: (name, build spec). Both
@@ -272,28 +336,41 @@ _TRAFFIC_ENTRIES: Tuple[Tuple[str, dict], ...] = (
     ("traffic_prefill_tb16_hb4_gather",
      {"kind": "prefill", "hb": 4, "attn": "gather"}),
     ("traffic_decode_chunk_tp2", {"kind": "decode", "tp": True}),
+    ("traffic_decode_chunk_tp2_psum",
+     {"kind": "decode", "tp": True, "combine": "psum"}),
+    ("traffic_decode_chunk_tp2_replicated",
+     {"kind": "decode", "tp": True, "ws": False}),
+    ("traffic_verify_window_tp2", {"kind": "verify", "tp": True}),
+    ("traffic_prefill_tb16_hb0_tp2",
+     {"kind": "prefill", "hb": 0, "tp": True}),
     ("traffic_prefill_tb16_hb4_kernel_tp2",
      {"kind": "prefill", "hb": 4, "attn": "kernel", "tp": True}),
+    ("traffic_prefill_tb16_hb4_gather_tp2",
+     {"kind": "prefill", "hb": 4, "attn": "gather", "tp": True}),
 )
 
 
 def _make_traffic_build(kind: str, hb: int = 0, attn=None,
-                        tp: bool = False) -> Callable[[], tuple]:
+                        tp: bool = False, ws: bool = True,
+                        combine: str = "all_gather") -> Callable[[], tuple]:
     def build():
         if kind == "decode":
-            eng = _traffic_engine(tp=tp)
+            eng = _traffic_engine(tp=tp, weight_sharding=ws,
+                                  tp_combine=combine)
             return eng._decode, (
                 eng.params, eng._k, eng._v, eng._ks, eng._vs,
                 eng._table_np.copy(), eng._lens, eng._last,
                 np.asarray([True, True, False]), np.int32(2))
         if kind == "verify":
-            eng = _traffic_engine(speculative=True, tp=tp)
+            eng = _traffic_engine(speculative=True, tp=tp,
+                                  weight_sharding=ws, tp_combine=combine)
             return eng._decode, (
                 eng.params, eng._k, eng._v, eng._ks, eng._vs,
                 eng._table_np.copy(), eng._lens, eng._last,
                 np.zeros((3, 4), np.int32),
                 np.asarray([True, True, False]))
-        eng = _traffic_engine(prefill_attn=attn, tp=tp)
+        eng = _traffic_engine(prefill_attn=attn, tp=tp,
+                              weight_sharding=ws, tp_combine=combine)
         slots = np.arange(3, dtype=np.int32)
         pids = np.tile(np.asarray([[5, 6]], np.int32), (3, 1))
         if hb:
@@ -351,34 +428,55 @@ def _audit_mesh():
     return make_mesh(MeshSpec.for_devices(tp, tp=tp))
 
 
-def _sharded_tiny_engine(speculative: bool = False):
+def _sharded_tiny_engine(speculative: bool = False,
+                         weight_sharding: bool = True,
+                         tp_combine: str = "all_gather"):
     """A multi-chip paged engine (shard_map islands over tp) at toy
     scale — the jitted dispatches the gspmd audit traces and the
-    recompile/donation scenarios drive."""
+    recompile/donation scenarios drive. Defaults to the runtime
+    default — Megatron-sliced weights, all_gather combine; the legacy
+    replicated-weight island (weight_sharding=False) and the psum
+    combine get their own scenarios."""
     import dataclasses
 
     from ..models import serving
 
     cfg, params = _tiny()
-    return serving.ContinuousBatcher(
-        params, dataclasses.replace(cfg, decode_attn="fused"), n_slots=2,
-        max_len=32, chunk=2, prefill_bucket=8, kv_dtype="int8",
-        kv_layout="paged", page_size=8, mesh=_audit_mesh(),
-        speculative=speculative, gamma=2 if speculative else 4)
+    # Deliberate legacy-layout builds (the audit's subject) neither
+    # warn nor count (see _traffic_engine).
+    with serving.fallback_notes_suppressed("weights_replicated"):
+        return serving.ContinuousBatcher(
+            params, dataclasses.replace(cfg, decode_attn="fused"),
+            n_slots=2,
+            max_len=32, chunk=2, prefill_bucket=8, kv_dtype="int8",
+            kv_layout="paged", page_size=8, mesh=_audit_mesh(),
+            weight_sharding=weight_sharding, tp_combine=tp_combine,
+            speculative=speculative, gamma=2 if speculative else 4)
 
 
 def gspmd_entrypoints() -> List[Tuple[str, Callable, tuple, dict]]:
     """(name, fn, args, expectations) for the GSPMD sharding audit
     (analysis/gspmd.py): the mesh-constrained static generate path
     (``cache_spec=True`` — its rank-5 cache constraints must match
-    CACHE_SPEC) and the three paged serving islands (``pool_spec=True``
-    — their rank-5 pool operands must map the kv-heads dim to tp)."""
+    CACHE_SPEC), the paged serving islands (``pool_spec=True`` — their
+    rank-5 pool operands must map the kv-heads dim to tp;
+    ``weight_specs=True`` — their [L, K, N] weight operands must slice
+    per the WEIGHT_SPECS table, column on the output axis, row on the
+    input axis), and the legacy replicated-weight island
+    (weight_sharding=False — pool expectations only, by design). The
+    weight expectation needs a REAL tp >= 2 mesh (at tp = 1 the engine
+    keeps replicated weights — there is nothing to slice), so it drops
+    to pool-only on a single-device host."""
+    import jax
     import jax.numpy as jnp
 
     from ..models import serving
 
     cfg, params = _tiny()
     mesh = _audit_mesh()
+    wspec = {"pool_spec": True,
+             **({"weight_specs": True} if len(jax.devices()) >= 2
+                else {})}
     prompt = jnp.zeros((2, 8), jnp.int32)
     entries: List[Tuple[str, Callable, tuple, dict]] = [
         ("generate_sharded",
@@ -396,13 +494,13 @@ def gspmd_entrypoints() -> List[Tuple[str, Callable, tuple, dict]]:
         "batcher_decode_paged_tp", eng._decode,
         (eng.params, eng._k, eng._v, eng._ks, eng._vs,
          eng._table_np.copy(), eng._lens, eng._last,
-         np.asarray([True, False]), np.int32(2)), {"pool_spec": True}))
+         np.asarray([True, False]), np.int32(2)), dict(wspec)))
     entries.append((
         "batcher_prefill_paged_tp", eng._prefill,
         (eng.params, eng._k, eng._v, eng._ks, eng._vs, eng._lens,
          eng._last, slots, pids, np.zeros((2, 0), np.int32),
          np.zeros((2,), np.int32), tokens8, lens, np.int32(1)),
-        {"pool_spec": True}))
+        dict(wspec)))
     # Prefix tail-prefill rung (hb=1) inside the island: the Pallas
     # prefix-attention kernel runs per shard on its local head family
     # with the pool operands mapped per POOL_SPEC — the same
@@ -412,14 +510,32 @@ def gspmd_entrypoints() -> List[Tuple[str, Callable, tuple, dict]]:
         (eng.params, eng._k, eng._v, eng._ks, eng._vs, eng._lens,
          eng._last, slots, pids, np.full((2, 1), 2, np.int32),
          np.full((2,), 8, np.int32), tokens8, lens, np.int32(1)),
-        {"pool_spec": True}))
+        dict(wspec)))
     seng = _sharded_tiny_engine(speculative=True)
     entries.append((
         "batcher_verify_paged_tp", seng._decode,
         (seng.params, seng._k, seng._v, seng._ks, seng._vs,
          seng._table_np.copy(), seng._lens, seng._last,
          np.zeros((2, 2), np.int32), np.asarray([True, False])),
-        {"pool_spec": True}))
+        dict(wspec)))
+    # psum combine: same sliced-weight expectations — the combine only
+    # changes the body's collectives, never the operand layout.
+    peng = _sharded_tiny_engine(tp_combine="psum")
+    entries.append((
+        "batcher_decode_paged_tp_psum", peng._decode,
+        (peng.params, peng._k, peng._v, peng._ks, peng._vs,
+         peng._table_np.copy(), peng._lens, peng._last,
+         np.asarray([True, False]), np.int32(2)), dict(wspec)))
+    # Legacy replicated-weight island (weight_sharding=False): pool
+    # expectations hold, weight expectations deliberately NOT declared
+    # — and the tests pin that auditing it WITH weight_specs=True is
+    # flagged (the loud version of the old silent layout).
+    leng = _sharded_tiny_engine(weight_sharding=False)
+    entries.append((
+        "batcher_decode_paged_tp_replicated", leng._decode,
+        (leng.params, leng._k, leng._v, leng._ks, leng._vs,
+         leng._table_np.copy(), leng._lens, leng._last,
+         np.asarray([True, False]), np.int32(2)), {"pool_spec": True}))
     return entries
 
 
@@ -701,7 +817,7 @@ def _paged_spec_batcher_scenario() -> tuple:
     return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
 
 
-def _sharded_paged_batcher_scenario() -> tuple:
+def _sharded_paged_batcher_scenario(weight_sharding: bool = False) -> tuple:
     """Multi-chip edition of the paged scenario: steady-state decode on a
     FORCED multi-device host mesh (shard_map islands over tp, pool
     sharded on kv heads) across waves whose block tables differ — the
@@ -709,8 +825,14 @@ def _sharded_paged_batcher_scenario() -> tuple:
     jit keys now include shardings, so this scenario is the guard the
     ROADMAP asked to run \"under a real multi-process mesh\" in its
     CI-reachable form (XLA host-platform device virtualization exercises
-    the same GSPMD/shard_map partitioning the TPU path uses)."""
-    eng = _sharded_tiny_engine()
+    the same GSPMD/shard_map partitioning the TPU path uses).
+    ``weight_sharding=True`` is the Megatron-sliced edition
+    (batcher_steady_decode_paged_tp_wsharded): the params pytree rides
+    the islands SLICED and committed once at engine birth, so steady
+    state must additionally prove the sliced-weight placement never
+    re-keys the jit cache; False keeps the PR 12 legacy replicated
+    island covered."""
+    eng = _sharded_tiny_engine(weight_sharding=weight_sharding)
     rng = np.random.default_rng(0)
     cfg = eng.cfg
 
@@ -764,6 +886,8 @@ def recompile_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
         ("batcher_steady_decode_paged_spec", _paged_spec_batcher_scenario),
         ("batcher_steady_mixed_chunked", _paged_chunked_batcher_scenario),
         ("batcher_steady_decode_paged_tp", _sharded_paged_batcher_scenario),
+        ("batcher_steady_decode_paged_tp_wsharded",
+         partial(_sharded_paged_batcher_scenario, weight_sharding=True)),
         ("batcher_steady_prefix_kernel", _prefix_kernel_multiturn_scenario),
         ("generate_steady_state", _generate_scenario),
     ]
@@ -851,6 +975,18 @@ def donation_audit() -> List:
     findings += check_donation(teng._decode, *targs,
                                donated=(1, 2, 3, 4, 5),
                                name="batcher_decode_paged_tp")
+
+    # Legacy replicated-weight island: the donation contract must hold
+    # on BOTH island layouts (the wsharded default above rides sliced
+    # params — NOT donated — next to the donated pool shards; the
+    # legacy mode keeps the PR 12 arrangement covered).
+    reng = _sharded_tiny_engine(weight_sharding=False)
+    rargs = (reng.params, reng._k, reng._v, reng._ks, reng._vs,
+             jnp.asarray(reng._table_np), reng._lens, reng._last,
+             np.asarray([True, True]), np.int32(1))
+    findings += check_donation(reng._decode, *rargs,
+                               donated=(1, 2, 3, 4, 5),
+                               name="batcher_decode_paged_tp_replicated")
 
     opt = optax.adamw(1e-3)
     state = jax.jit(opt.init)(params)

@@ -119,6 +119,21 @@ def _expected_cache_spec() -> Tuple[Tuple[str, ...], ...]:
     return _norm_spec(CACHE_SPEC, CACHE_RANK)
 
 
+def _expected_weight_mapping() -> Tuple[str, Dict[str, int]]:
+    """(tp axis name, {kind: sliced dim}) for Megatron-sliced serving
+    weights — derived from the SAME parallel/sharding.py WEIGHT_SPECS
+    table serving builds its per-leaf specs from (column slices the
+    output axis of the stacked [L, K, N] layout, row the input axis),
+    so the runtime and this guard rail cannot drift."""
+    from ..parallel.sharding import (
+        DEFAULT_RULES, WEIGHT_COLUMN_DIM, WEIGHT_ROW_DIM, WEIGHT_SPECS,
+    )
+
+    dims = {"column": WEIGHT_COLUMN_DIM, "row": WEIGHT_ROW_DIM}
+    return str(DEFAULT_RULES["kv_heads"]), {
+        kind: dims[kind] for kind in set(WEIGHT_SPECS.values())}
+
+
 def _spec_axes(norm) -> Set[str]:
     return {a for dim in norm for a in dim}
 
@@ -139,6 +154,7 @@ def _iter_subjaxprs(params: dict):
 
 def audit_sharded_jaxpr(closed, name: str, cache_spec: bool = False,
                         pool_spec: bool = False,
+                        weight_specs: bool = False,
                         carry_elems_limit: int = CARRY_ELEMS_LIMIT,
                         replicated_bytes_limit: int = REPLICATED_BYTES_LIMIT,
                         ) -> List[Finding]:
@@ -274,6 +290,62 @@ def audit_sharded_jaxpr(closed, name: str, cache_spec: bool = False,
                 f"{name}: island carries no rank-5 pool operand mapped "
                 f"{expected_pool} — the pool is not sharded through "
                 f"the island"))
+
+    if weight_specs:
+        # Megatron-sliced serving weights (WEIGHT_SPECS): every rank-3
+        # [L, K, N] weight operand of an island must be mapped on
+        # exactly ONE of its two matmul dims to the tp axis — column
+        # slices the output axis, row the input axis — and across the
+        # entry BOTH kinds must appear (a q/k/v-only slicing still
+        # replicates o/down). Scale planes ([L, 1, N]) are exempt via
+        # the min > 1 guard; shapes are never consulted beyond rank, so
+        # toy-scale dim collisions (d == H·hd) cannot blind the check.
+        tp_axis, kind_dims = _expected_weight_mapping()
+        legal_dims = set(kind_dims.values())
+        seen_dims: Set[int] = set()
+        for eqn in islands:
+            in_names = eqn.params.get("in_names") or ()
+            for var, names in zip(eqn.invars, in_names):
+                shape = var.aval.shape
+                if len(shape) != 3 or min(int(shape[1]),
+                                          int(shape[2])) <= 1:
+                    continue
+                mapped = {int(d): tuple(str(a) for a in ax)
+                          for d, ax in dict(names).items()}
+                if not mapped:
+                    findings.append(Finding(
+                        "island-weight-spec", anchor, 0,
+                        f"{name}: island weight operand {tuple(shape)} "
+                        f"is unmapped — a REPLICATED weight inside a "
+                        f"weight-sharded island: per-chip weight bytes "
+                        f"do not scale 1/tp"))
+                    continue
+                dims = set(mapped)
+                if (len(dims) != 1 or not dims <= legal_dims
+                        or any(ax != (tp_axis,)
+                               for ax in mapped.values())):
+                    findings.append(Finding(
+                        "island-weight-spec", anchor, 0,
+                        f"{name}: island weight operand {tuple(shape)} "
+                        f"mapped {mapped}, expected exactly one of dims "
+                        f"{sorted(legal_dims)} on ('{tp_axis}',) "
+                        f"(WEIGHT_SPECS: column → output axis "
+                        f"{kind_dims.get('column')}, row → input axis "
+                        f"{kind_dims.get('row')})"))
+                    continue
+                seen_dims |= dims
+        missing = legal_dims - seen_dims
+        if islands and missing and not any(
+                f.rule == "island-weight-spec" for f in findings):
+            findings.append(Finding(
+                "island-weight-spec", anchor, 0,
+                f"{name}: entry registered with weight_specs=True but "
+                f"no island weight operand is sliced on dim(s) "
+                f"{sorted(missing)} — "
+                + ("no weights ride the island sliced at all"
+                   if not seen_dims else
+                   "one Megatron half is missing (column AND row "
+                   "slices must both appear)")))
 
     for eqn, in_island in scans:
         if in_island:

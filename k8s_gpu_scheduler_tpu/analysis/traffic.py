@@ -86,13 +86,22 @@ class TrafficContract:
     ``residency_multiple`` bounds peak pool-scale live bytes as a
     multiple of the pool working set (None skips the residency check).
     ``tp`` > 1 marks an island entry: rank-5 pool values inside its
-    shard_map must carry the kv-heads dim at 1/tp."""
+    shard_map must carry the kv-heads dim at 1/tp. ``weight_sharded``
+    marks a Megatron-sliced-weight island (serving
+    ``weight_sharding=True``): every [L, K, N] weight INVAR of the
+    shard_map must carry a sliced dim — a full (d, d)/(d, ffn)/(ffn, d)
+    operand (matched against the geometry's ``d``/``d_ff``) is the
+    replicated-weight layout, i.e. per-chip weight bytes that do NOT
+    scale 1/tp, flagged as a ``traffic-contract`` finding. Only island
+    INVARS are checked: the all_gather combine legitimately
+    rematerializes a full weight as a transient inside the body."""
     kv_scale: Mapping[str, int] = field(default_factory=dict)
     dense_ok: bool = False
     rationale: str = ""
     donated: Tuple[int, ...] = ()
     residency_multiple: Optional[float] = 1.25
     tp: int = 1
+    weight_sharded: bool = False
 
     def __post_init__(self):
         if self.dense_ok and not self.rationale.strip():
@@ -270,6 +279,45 @@ def audit_traffic_jaxpr(closed, name: str, geometry: Mapping[str, int],
                      f"{hkv // contract.tp} — the island moves full "
                      f"pool-dim traffic instead of 1/tp per chip")
 
+    def check_island_weights(jaxpr) -> None:
+        """Megatron-sliced-weight islands (contract.weight_sharded):
+        every [L, K, N] weight INVAR must carry a sliced dim. Matching
+        is by the geometry's full ``d``/``d_ff`` values — the registry
+        builds its audit engines so the tp-sliced widths (d/tp, ffn/tp)
+        collide with neither — and scale planes ([L, 1, N]) are exempt
+        via the min(K, N) > 1 guard. Island invars only: the all_gather
+        combine legitimately regathers a full weight inside the body."""
+        if not contract.weight_sharded:
+            return
+        L = geometry.get("L")
+        full_dims = {geometry.get("d"), geometry.get("d_ff")} - {None}
+        if not L or not full_dims:
+            emit("traffic-contract", "weights:vacuous-geometry",
+                 f"{name}: contract declares weight_sharded but the "
+                 f"geometry lacks L/d/d_ff — the replicated-weight "
+                 f"check is vacuous; the geometry mapping has drifted",
+                 severity="warning")
+            return
+        shaped = 0
+        for v in jaxpr.invars:
+            shape = getattr(getattr(v, "aval", None), "shape", None)
+            if shape is None or len(shape) != 3 or int(shape[0]) != L \
+                    or min(int(shape[1]), int(shape[2])) <= 1:
+                continue
+            shaped += 1
+            if int(shape[1]) in full_dims and int(shape[2]) in full_dims:
+                emit("traffic-contract", f"weights:{tuple(shape)}",
+                     f"{name}: island weight invar {tuple(shape)} is the "
+                     f"FULL [L, K, N] matrix — a replicated weight "
+                     f"operand inside a weight_sharded island: per-chip "
+                     f"weight bytes do not scale 1/tp (the HBM wall "
+                     f"Megatron slicing exists to remove)")
+        if not shaped:
+            emit("traffic-contract", "weights:none",
+                 f"{name}: contract declares weight_sharded but the "
+                 f"island has no [L, K, N] weight invars at all — the "
+                 f"weights are not riding the island sliced")
+
     def visit(jaxpr, in_island: bool) -> None:
         for eqn in jaxpr.eqns:
             prim = eqn.primitive.name
@@ -279,6 +327,7 @@ def audit_traffic_jaxpr(closed, name: str, geometry: Mapping[str, int],
             for _key, sub in _iter_subjaxprs(eqn.params):
                 if prim == "shard_map":
                     check_island_pool(sub)
+                    check_island_weights(sub)
                 visit(sub, in_island or prim == "shard_map")
 
     top, donated = _unwrap(closed.jaxpr, set(donated_invars or ()))

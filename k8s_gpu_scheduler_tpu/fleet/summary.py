@@ -72,6 +72,13 @@ class ReplicaSummary:
     # and operators read this to tell scale-UP replicas from scale-OUT
     # ones. Default 1 keeps pre-sharding summaries parsing.
     tp: int = 1
+    # Per-chip model-weight residency (Megatron-sliced weights,
+    # models/serving.py weight_sharding): 1/tp-sliced projections + the
+    # replicated remainder — the capacity axis that distinguishes a
+    # replica that actually FITS big weights per chip from a
+    # replicated-weight one at the same tp. Default 0 keeps
+    # pre-weight-sharding summaries parsing.
+    weight_device_bytes: int = 0
     # [(token path, full cached token length)], hottest first.
     digest: List[Tuple[List[int], int]] = field(default_factory=list)
 
@@ -117,6 +124,7 @@ def summarize(engine, replica: str, fleet: str = "fleet", seq: int = 0,
         prefill_p50_s=float(prefill_p50_s),
         prefill_backlog_tokens=int(st.get("prefill_backlog_tokens", 0)),
         tp=int(st.get("tp", 1)),
+        weight_device_bytes=int(st.get("weight_device_bytes", 0)),
         digest=engine.cache_digest(top_k, max_tokens),
     )
 

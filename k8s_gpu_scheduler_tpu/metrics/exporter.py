@@ -263,6 +263,16 @@ SERVING_POOL_GAUGES = {
     "tp": "tensor-parallel island width (1 = single-chip)",
     "kv_pool_device_bytes":
         "per-chip KV pool residency (pool + scale-plane shard bytes)",
+    # Megatron-sliced weights (serving weight_sharding): per-chip weight
+    # residency — total, and the WEIGHT_SPECS-sliced subset, which is
+    # exactly 1/tp of its unsharded size by construction (the
+    # sharded_weights bench leg CI-asserts it). Build-time constants,
+    # never live-array reads (the kv_pool_device_bytes contract).
+    "weight_device_bytes":
+        "per-chip model-weight residency (sliced + replicated leaves)",
+    "weight_sliced_device_bytes":
+        "per-chip bytes of the Megatron-sliced weight leaves "
+        "(exactly 1/tp of their unsharded total)",
     "spec_accept_rate": "speculative proposals accepted / proposed",
     "spec_tokens_per_dispatch":
         "tokens committed per active slot per verify dispatch",
@@ -305,6 +315,12 @@ PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
 PREFIX_HIT_HISTOGRAM = "tpu_serve_prefix_hit_tokens"
 PREFIX_HIT_BUCKETS = (8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
                       1024.0, 2048.0, 4096.0, 8192.0)
+
+# Info-style metric for the island weight-combine mode (pool_metrics()
+# "tp_combine": "all_gather" | "psum" | "replicated" | "none"): value 1
+# under {kind=} — the PromQL-friendly encoding of an enum that never
+# changes after engine birth, so no stale one-hot cleanup is needed.
+TP_COMBINE_INFO = "tpu_serve_tp_combine"
 
 
 def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
@@ -352,6 +368,13 @@ def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
             buckets=PREFIX_HIT_BUCKETS)
         for tokens in hits:
             hist.observe(float(tokens), **labels)
+    combine = pool_metrics.get("tp_combine")
+    if combine:
+        registry.gauge(
+            TP_COMBINE_INFO,
+            "island weight-combine mode (Megatron-sliced weights), "
+            "info-style: 1 under {kind=all_gather|psum|replicated|none}",
+        ).set(1.0, kind=str(combine), **labels)
 
 
 # Decode fused→dense downgrade visibility (models/serving.py

@@ -139,6 +139,45 @@ def param_specs(cfg: LlamaConfig, rules: Optional[Dict] = None) -> Dict:
     )
 
 
+def serving_weight_specs(params: Dict, rules: Optional[Dict] = None) -> Dict:
+    """Per-leaf PartitionSpecs for a SERVING params pytree under Megatron
+    weight sharding (models/serving.py weight_sharding=True): the block
+    projections and MLP weights slice per the parallel/sharding.py
+    WEIGHT_SPECS table — column-parallel q/k/v/gate/up on their OUTPUT
+    axis, row-parallel o/down on their INPUT axis — and everything else
+    (embed, norms, lm_head) replicates. Walks the ACTUAL params tree, so
+    weight-only int8 leaves (ops/quant.py ``{"q","s"}`` dicts) slice
+    coherently: ``q`` follows the weight's spec and the per-output-
+    channel scale ``s`` [L, 1, N] slices with a column's N and stays
+    replicated for a row slice (the scale spans the FULL contraction —
+    slicing after quantization keeps every shard's dequant exact).
+    Dense-MLP trees only: MoE expert stacks route through qeinsum shapes
+    this table does not describe, and the engine rejects them up front."""
+    from ..parallel.sharding import WEIGHT_SPECS, weight_slice_spec
+
+    def replicated(leaf):
+        return jax.tree.map(lambda _: P(), leaf)
+
+    def block_leaf(name, leaf):
+        kind = WEIGHT_SPECS.get(name)
+        if kind is None:
+            return replicated(leaf)
+        spec = weight_slice_spec(kind, rules)
+        if isinstance(leaf, dict):                   # int8 {"q","s"}
+            return {"q": spec,
+                    "s": spec if kind == "column" else P()}
+        return spec
+
+    if "router" in params.get("blocks", {}):
+        raise ValueError(
+            "serving weight sharding covers dense-MLP trees only "
+            "(MoE expert stacks shard over ep, not tp)")
+    out = {k: replicated(v) for k, v in params.items() if k != "blocks"}
+    out["blocks"] = {k: block_leaf(k, v)
+                     for k, v in params["blocks"].items()}
+    return out
+
+
 def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict:
     ks = jax.random.split(key, 8)
     D, H, Hkv, hd, F, L = (

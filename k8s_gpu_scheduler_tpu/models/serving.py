@@ -131,7 +131,7 @@ POOL_SPEC = _trim_spec(spec_for(KV_POOL_AXES, DEFAULT_RULES))
 _decode_fallback_counts: Dict[str, int] = {}
 
 
-def _note_decode_fallback(reason: str) -> None:
+def _note_decode_fallback(reason: str, msg: Optional[str] = None) -> None:
     import warnings
 
     first = reason not in _decode_fallback_counts
@@ -139,11 +139,43 @@ def _note_decode_fallback(reason: str) -> None:
         _decode_fallback_counts.get(reason, 0) + 1
     if first:
         warnings.warn(
-            f"decode_attn='fused' downgraded to the dense path "
-            f"(reason={reason}): the config asked for the Pallas decode "
-            f"kernel and is not getting it — see "
-            f"tpu_serve_decode_fallback_total{{reason={reason!r}}}",
+            msg or (
+                f"decode_attn='fused' downgraded to the dense path "
+                f"(reason={reason}): the config asked for the Pallas "
+                f"decode kernel and is not getting it — see "
+                f"tpu_serve_decode_fallback_total{{reason={reason!r}}}"),
             RuntimeWarning, stacklevel=3)
+
+
+def fallback_notes_suppressed(*reasons: str):
+    """Context manager for DELIBERATE-downgrade engine builds (the
+    graftcheck audit registries, fixtures): the build neither warns nor
+    counts — counter AND warn-once state for ``reasons`` are restored
+    on exit, so the first REAL engine still warns and
+    ``tpu_serve_decode_fallback_total`` counts only production
+    decisions, never the audit's throwaway engines."""
+    import warnings
+    from contextlib import contextmanager
+
+    @contextmanager
+    def cm():
+        before = {r: _decode_fallback_counts.get(r) for r in reasons}
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                yield
+        finally:
+            # Restore even if the wrapped build raises mid-__init__
+            # (after its _note_decode_fallback but before finishing) —
+            # otherwise the reason's warn-once is permanently consumed
+            # and the counter keeps an audit-throwaway engine's mark.
+            for r, v in before.items():
+                if v is None:
+                    _decode_fallback_counts.pop(r, None)
+                else:
+                    _decode_fallback_counts[r] = v
+
+    return cm()
 
 
 def decode_fallback_counts() -> Dict[str, int]:
@@ -714,10 +746,150 @@ def _tp_heads(x, tp_axis: str, n_local: int, axis: int):
         x, jax.lax.axis_index(tp_axis) * n_local, n_local, axis)
 
 
+# -- Megatron-sliced weights (weight_sharding=True) ---------------------------
+#
+# PR 12's islands kept every weight matrix REPLICATED: each chip computed
+# the FULL q/k/v/o and MLP projections and then sliced out its local head
+# family (_tp_heads), so per-chip HBM weight bytes and projection FLOPs
+# didn't scale with tp at all. With weight sharding the params pytree
+# itself rides the island sliced per parallel/sharding.py's WEIGHT_SPECS
+# (models/llama.py serving_weight_specs): column-parallel q/k/v/gate/up
+# slices [d, N/tp] compute each shard's contiguous head/ffn family
+# DIRECTLY (a matmul's output columns are independent — the slice is
+# byte-identical to slicing the full product, no combine needed), and
+# row-parallel o/down slices [K/tp, d] contract the shard's 1/tp input
+# slice with ONE combine per projection:
+#
+# - combine="all_gather" (default): all_gather the activation AND the
+#   weight slice, then run the full matmul — data movement only, the
+#   arithmetic is the monolithic dot, so sharded streams stay
+#   byte-identical to replicated-weight and tp=1 runs (the PR 12
+#   identity contract, preserved);
+# - combine="psum": contract locally and psum the partial products —
+#   1/tp the FLOPs and no weight bytes on the wire, but the reduction
+#   ORDER differs from the monolithic dot, so this mode is
+#   tolerance-checked rather than byte-pinned.
+#
+# Weight-only int8 leaves ({"q","s"}, ops/quant.py) slice AFTER
+# quantization: the per-output-channel scale spans the full contraction
+# dim, so a column slice takes its scale columns and a row slice keeps
+# the scale whole — every shard's dequant is exact either way.
+
+
+def _map_weight_tree(params, specs, fn):
+    """Walk a params pytree and its mirror-shaped spec tree together
+    (plain nested dicts with array — or int8 ``{"q","s"}`` — leaves;
+    the shape serving_weight_specs emits). jax.tree.map is avoided on
+    purpose: PartitionSpec is itself a sequence and tree-flattening it
+    against array leaves is version-dependent."""
+    if isinstance(params, dict):
+        return {k: _map_weight_tree(params[k], specs[k], fn)
+                for k in params}
+    return fn(params, specs)
+
+
+def _gather_weight(w, tp_axis: str, axis: int = 0):
+    """All-gather a row-parallel weight slice back to the full matrix
+    (movement-only — tiled concat in shard order matches the unsliced
+    layout). int8 leaves gather ``q``; the per-output-channel scale is
+    replicated for row slices and multiplies after the full dot."""
+    if isinstance(w, dict):
+        return {"q": jax.lax.all_gather(w["q"], tp_axis, axis=axis,
+                                        tiled=True),
+                "s": w["s"]}
+    return jax.lax.all_gather(w, tp_axis, axis=axis, tiled=True)
+
+
+def _psum_qdot(x, w, tp_axis: str):
+    """Row-parallel qdot, psum combine: each shard contracts its 1/tp
+    input slice and the partial products accumulate in f32 across the
+    island. The per-output-channel int8 scale applies AFTER the psum —
+    it is constant across shards, so scale(psum) == psum(scale) exactly
+    in real arithmetic; the float reduction order still differs from the
+    monolithic dot, hence tolerance-checked."""
+    if isinstance(w, dict):
+        y = x @ w["q"].astype(x.dtype)
+        y = jax.lax.psum(y.astype(jnp.float32), tp_axis)
+        return (y * w["s"]).astype(x.dtype)
+    return jax.lax.psum((x @ w).astype(jnp.float32),
+                        tp_axis).astype(x.dtype)
+
+
+def _qkv_local(cfg: LlamaConfig, h, blk, angles, lead, tp_axis,
+               tp: int, wsharded: bool):
+    """Roped q/k/v for this shard's head family — THE one projection
+    block every island body shares (decode tick, verify window, both
+    prefill tail branches; ``lead`` is the (batch, rows) shape prefix).
+    Weight-sharded islands compute the local family DIRECTLY from the
+    Megatron column slices (byte-exact — output columns are
+    independent); legacy islands compute the full projections from
+    replicated weights and slice (_tp_heads — rope is per-head
+    elementwise, so rope-then-slice equals slice-then-rope and both
+    layouts produce identical bytes per family)."""
+    hd = cfg.head_dim
+    if wsharded:
+        q = qdot(h, blk["wq"]).reshape(*lead, cfg.n_heads // tp, hd)
+        kk = qdot(h, blk["wk"]).reshape(*lead, cfg.n_kv_heads // tp, hd)
+        vv = qdot(h, blk["wv"]).reshape(*lead, cfg.n_kv_heads // tp, hd)
+        return apply_rope(q, angles), apply_rope(kk, angles), vv
+    q = qdot(h, blk["wq"]).reshape(*lead, cfg.n_heads, hd)
+    kk = qdot(h, blk["wk"]).reshape(*lead, cfg.n_kv_heads, hd)
+    vv = qdot(h, blk["wv"]).reshape(*lead, cfg.n_kv_heads, hd)
+    q, kk = apply_rope(q, angles), apply_rope(kk, angles)
+    if tp_axis is not None:
+        ax = len(lead)
+        q = _tp_heads(q, tp_axis, cfg.n_heads // tp, ax)
+        kk = _tp_heads(kk, tp_axis, cfg.n_kv_heads // tp, ax)
+        vv = _tp_heads(vv, tp_axis, cfg.n_kv_heads // tp, ax)
+    return q, kk, vv
+
+
+def _attn_residual(x, attn, wo, lead, gather_axis: int, tp_axis,
+                   wsharded: bool, combine: str):
+    """Residual + output projection with the island head combine. attn
+    is the shard's LOCAL head-family output ([..., Hloc(, g), hd]);
+    legacy replicated-weight islands all_gather it and multiply the full
+    wo (PR 12, byte-identical), weight-sharded islands combine per the
+    module comment above. Off-island this is exactly the unsharded
+    epilogue."""
+    if tp_axis is not None and (not wsharded or combine == "all_gather"):
+        # Exact head-axis reassembly (movement only — each q head's
+        # whole kv group is shard-local, so no cross-shard arithmetic).
+        attn = jax.lax.all_gather(attn, tp_axis, axis=gather_axis,
+                                  tiled=True)
+    flat = attn.reshape(*lead, -1)
+    if tp_axis is None or not wsharded:
+        return x + qdot(flat, wo)
+    if combine == "all_gather":
+        return x + qdot(flat, _gather_weight(wo, tp_axis))
+    return x + _psum_qdot(flat, wo, tp_axis)
+
+
+def _mlp_residual(cfg: LlamaConfig, x, blk, tp_axis, wsharded: bool,
+                  combine: str):
+    """MLP half of a serving block: the shared ``mlp_sublayer`` off the
+    island / with replicated weights, the Megatron-sliced dense SwiGLU
+    inside a weight-sharded island — gate/up column slices compute the
+    shard's ffn family directly (exact), down combines per the module
+    comment (all_gather = byte-identical, psum = one reduction)."""
+    if tp_axis is None or not wsharded:
+        x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
+        return x
+    h = rms_norm(x, blk["mlp_norm"])
+    act = jax.nn.silu(qdot(h, blk["w_gate"])) * qdot(h, blk["w_up"])
+    if combine == "all_gather":
+        act = jax.lax.all_gather(act, tp_axis, axis=act.ndim - 1,
+                                 tiled=True)
+        return x + qdot(act, _gather_weight(blk["w_down"], tp_axis))
+    return x + _psum_qdot(act, blk["w_down"], tp_axis)
+
+
 def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
                            page_size: int, k, v, table, lens, last, active,
                            seed, temperature: float = 0.0, top_k: int = 0,
-                           k_s=None, v_s=None, tp_axis=None, tp: int = 1):
+                           k_s=None, v_s=None, tp_axis=None, tp: int = 1,
+                           wsharded: bool = False,
+                           combine: str = "all_gather"):
     """Advance every active slot ``chunk`` tokens against the paged pool
     k/v [L, n_pages, ps, Hkv, hd] with block table [B, n_blocks] and
     per-slot filled lengths [B]. The table is read-only here (pages are
@@ -753,8 +925,6 @@ def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
     row_ids = jnp.arange(B)
     base_key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
     active_i = jnp.asarray(active)
-    h_loc = cfg.n_heads // tp
-    hkv_loc = cfg.n_kv_heads // tp
 
     def one_token(carry, tick):
         k, v, k_s, v_s, lens, last = carry
@@ -772,19 +942,11 @@ def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
         def block(x, layer):
             blk, k_pg, v_pg, ks_p, vs_p = layer      # [n_pages, ps, Hkv, hd]
             h = rms_norm(x, blk["attn_norm"])
-            q = qdot(h, blk["wq"]).reshape(B, 1, cfg.n_heads, cfg.head_dim)
-            kk = qdot(h, blk["wk"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
-            vv = qdot(h, blk["wv"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
-            q, kk = apply_rope(q, angles), apply_rope(kk, angles)
-            if tp_axis is not None:
-                # Island mode: this shard's contiguous head family. The
-                # full projections above are computed from replicated
-                # inputs — identical on every chip — so the slice is the
-                # only divergence, and the kernel below sees exactly the
-                # per-shard pool shapes.
-                q = _tp_heads(q, tp_axis, h_loc, 2)
-                kk = _tp_heads(kk, tp_axis, hkv_loc, 2)
-                vv = _tp_heads(vv, tp_axis, hkv_loc, 2)
+            # Local head family — sliced weights or legacy full+slice
+            # (_qkv_local); the kernel below sees exactly the
+            # per-shard pool shapes either way.
+            q, kk, vv = _qkv_local(cfg, h, blk, angles, (B, 1),
+                                   tp_axis, tp, wsharded)
             if quant:
                 kq, ksn = _kv_quant(kk)
                 vq, vsn = _kv_quant(vv)
@@ -814,16 +976,9 @@ def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
                 attn = dense_decode_reference(
                     q[:, 0], gather_paged_kv(k_pg, table),
                     gather_paged_kv(v_pg, table), lengths=lens + 1, **dsc)
-            if tp_axis is not None:
-                # Exact combine: per-head outputs are complete within
-                # their shard (each q head's whole kv-head group is
-                # local), so reassembling the head axis is data movement
-                # only — no cross-shard arithmetic, hence byte identity
-                # with the unsharded program.
-                attn = jax.lax.all_gather(attn, tp_axis, axis=1, tiled=True)
-            x = x + qdot(attn.reshape(B, 1, cfg.n_heads * cfg.head_dim),
-                         blk["wo"])
-            x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
+            x = _attn_residual(x, attn, blk["wo"], (B, 1), 1, tp_axis,
+                               wsharded, combine)
+            x = _mlp_residual(cfg, x, blk, tp_axis, wsharded, combine)
             return x, (k_pg, v_pg, ks_p, vs_p)
 
         x, (k, v, k_s, v_s) = jax.lax.scan(
@@ -846,7 +1001,8 @@ def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
 def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
                            page_size: int, k, v, table, lens, last, props,
                            active, k_s=None, v_s=None, tp_axis=None,
-                           tp: int = 1):
+                           tp: int = 1, wsharded: bool = False,
+                           combine: str = "all_gather"):
     """One batched speculative VERIFY dispatch over every slot of the
     paged pool: score the t = 1+gamma window [last, props...] of each
     active slot in a single forward, accept the longest proposal prefix
@@ -892,8 +1048,6 @@ def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
              and verify_plan(n_blocks, page_size, t) is not None)
     if getattr(cfg, "decode_attn", "dense") == "fused" and not fused:
         _note_decode_fallback("no_verify_plan")
-    h_loc = cfg.n_heads // tp
-    hkv_loc = cfg.n_kv_heads // tp
     angles_full = rope_freqs(cfg.head_dim, S, cfg.rope_theta)
     row_ids = jnp.arange(B)
     active_i = jnp.asarray(active)
@@ -914,17 +1068,10 @@ def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
     def block(x, layer):
         blk, k_pg, v_pg, ks_p, vs_p = layer      # [n_pages, ps, Hkv, hd]
         h = rms_norm(x, blk["attn_norm"])
-        q = qdot(h, blk["wq"]).reshape(B, t, cfg.n_heads, cfg.head_dim)
-        kk = qdot(h, blk["wk"]).reshape(B, t, cfg.n_kv_heads, cfg.head_dim)
-        vv = qdot(h, blk["wv"]).reshape(B, t, cfg.n_kv_heads, cfg.head_dim)
-        q, kk = apply_rope(q, angles), apply_rope(kk, angles)
-        if tp_axis is not None:
-            # Island mode: this shard's head family (see
-            # _decode_chunk_paged_fn — same slice, t window rows instead
-            # of one).
-            q = _tp_heads(q, tp_axis, h_loc, 2)
-            kk = _tp_heads(kk, tp_axis, hkv_loc, 2)
-            vv = _tp_heads(vv, tp_axis, hkv_loc, 2)
+        # Local head family (see _qkv_local — same contract as the
+        # decode tick, t window rows instead of one).
+        q, kk, vv = _qkv_local(cfg, h, blk, angles, (B, t), tp_axis,
+                               tp, wsharded)
         if quant:
             kq, ksn = _kv_quant(kk)
             vq, vsn = _kv_quant(vv)
@@ -950,12 +1097,9 @@ def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
             attn = dense_verify_reference(
                 q, gather_paged_kv(k_pg, table),
                 gather_paged_kv(v_pg, table), lens, **dsc)
-        if tp_axis is not None:
-            # Exact head-axis reassembly (movement only — byte identity).
-            attn = jax.lax.all_gather(attn, tp_axis, axis=2, tiled=True)
-        x = x + qdot(attn.reshape(B, t, cfg.n_heads * cfg.head_dim),
-                     blk["wo"])
-        x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
+        x = _attn_residual(x, attn, blk["wo"], (B, t), 2, tp_axis,
+                           wsharded, combine)
+        x = _mlp_residual(cfg, x, blk, tp_axis, wsharded, combine)
         return x, (k_pg, v_pg, ks_p, vs_p)
 
     x, (k, v, k_s, v_s) = jax.lax.scan(
@@ -983,7 +1127,9 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
                             seed, temperature: float = 0.0,
                             top_k: int = 0, k_s=None, v_s=None,
                             tp_axis=None, tp: int = 1,
-                            prefill_attn: str = "auto"):
+                            prefill_attn: str = "auto",
+                            wsharded: bool = False,
+                            combine: str = "all_gather"):
     """Prefill M freed slots from right-padded prompts [M, tb] in ONE
     dispatch, paged edition: the batched mini cache computes every
     prompt's K/V exactly as the contiguous path, then ONE page-granular
@@ -1038,8 +1184,14 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
     npg = page_ids.shape[1]
     hb = prefix_tables.shape[1]
     hkv_loc = cfg.n_kv_heads // tp
-    if hb == 0:
-        # Plain path: tokens are whole prompts, nothing cached.
+    if hb == 0 and not wsharded:
+        # Plain path: tokens are whole prompts, nothing cached. Weight-
+        # sharded islands cannot take it — forward_with_cache reshapes
+        # to the FULL head set, which a 1/tp weight slice cannot feed —
+        # so they route hb == 0 through the tail branch below with an
+        # empty prefix (hp = 0): the same per-shard block walk, tail-
+        # only causal attention, and the column slices shard the
+        # prefill projections too.
         mini = {
             "k": jnp.zeros((cfg.n_layers, M, tb, cfg.n_kv_heads,
                             cfg.head_dim), cfg.dtype),
@@ -1075,12 +1227,20 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
         want_kernel = prefill_attn == "kernel" or (
             prefill_attn == "auto"
             and getattr(cfg, "decode_attn", "dense") == "fused")
-        use_kernel = (want_kernel
+        # hb == 0 reaches this branch only on weight-sharded islands
+        # (the plain path cannot feed full-head reshapes from 1/tp
+        # slices) and stays on the DENSE tail attention deliberately:
+        # the unsharded plain prefill is dense (forward_with_cache),
+        # and byte-identity of the all_gather combine requires the same
+        # softmax arithmetic — there is no cached prefix to stream, so
+        # the kernel has nothing to win here anyway. Not a downgrade,
+        # so nothing is counted.
+        use_kernel = (hb > 0 and want_kernel
                       and cfg.n_heads % cfg.n_kv_heads == 0
                       and tb % page_size == 0
-                      and prefill_plan(hb + tb // page_size, page_size,
-                                       tb * g) is not None)
-        if want_kernel and not use_kernel:
+                      and prefill_plan(hb + tb // page_size,
+                                       page_size, tb * g) is not None)
+        if hb > 0 and want_kernel and not use_kernel:
             _note_decode_fallback("no_prefill_plan")
         # Per-entry absolute positions: tail row i sits at hit_len + i
         # (clamped — the bucket's padded tail may overshoot the rope
@@ -1099,17 +1259,9 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
                 # the decode/verify dispatches.
                 blk, k_pg, v_pg, ks_p, vs_p = layer
                 h = rms_norm(x, blk["attn_norm"])
-                q = qdot(h, blk["wq"]).reshape(M, tb, cfg.n_heads,
-                                               cfg.head_dim)
-                kk = qdot(h, blk["wk"]).reshape(M, tb, cfg.n_kv_heads,
-                                                cfg.head_dim)
-                vv = qdot(h, blk["wv"]).reshape(M, tb, cfg.n_kv_heads,
-                                                cfg.head_dim)
-                q, kk = apply_rope(q, angles), apply_rope(kk, angles)
-                if tp_axis is not None:
-                    q = _tp_heads(q, tp_axis, (cfg.n_heads // tp), 2)
-                    kk = _tp_heads(kk, tp_axis, hkv_loc, 2)
-                    vv = _tp_heads(vv, tp_axis, hkv_loc, 2)
+                # Local head family (see _qkv_local), tb tail rows.
+                q, kk, vv = _qkv_local(cfg, h, blk, angles, (M, tb),
+                                       tp_axis, tp, wsharded)
                 scales = (dict(k_scale=ks_p, v_scale=vs_p)
                           if quant else {})
                 # Two-regime streamed attention: cached prefix pages
@@ -1120,14 +1272,10 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
                 attn = paged_prefill_attention(
                     q, k_pg, v_pg, prefix_tables, hit_lens, kk, vv,
                     **scales)
-                if tp_axis is not None:
-                    # Exact head-axis reassembly (movement only).
-                    attn = jax.lax.all_gather(attn, tp_axis, axis=2,
-                                              tiled=True)
-                x = x + qdot(attn.reshape(M, tb,
-                                          cfg.n_heads * cfg.head_dim),
-                             blk["wo"])
-                x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
+                x = _attn_residual(x, attn, blk["wo"], (M, tb), 2,
+                                   tp_axis, wsharded, combine)
+                x = _mlp_residual(cfg, x, blk, tp_axis, wsharded,
+                                  combine)
                 return x, (kk, vv)
 
             x, (mk, mv) = jax.lax.scan(
@@ -1155,23 +1303,13 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
             def block(x, layer):
                 blk, pk_l, pv_l = layer          # prefix K/V [M, hp, Hkv, hd]
                 h = rms_norm(x, blk["attn_norm"])
-                q = qdot(h, blk["wq"]).reshape(M, tb, cfg.n_heads,
-                                               cfg.head_dim)
-                kk = qdot(h, blk["wk"]).reshape(M, tb, cfg.n_kv_heads,
-                                                cfg.head_dim)
-                vv = qdot(h, blk["wv"]).reshape(M, tb, cfg.n_kv_heads,
-                                                cfg.head_dim)
-                q, kk = apply_rope(q, angles), apply_rope(kk, angles)
-                if tp_axis is not None:
-                    # Island mode: the gathered prefix (pk_l/pv_l) is
-                    # this shard's kv-head slice of the pool, so the
-                    # tail's q/k/v slice to the matching head family;
-                    # the scan ys (kk, vv) stay local — they are
-                    # exactly the rows this shard's pool scatter stores.
-                    q = _tp_heads(q, tp_axis,
-                                  (cfg.n_heads // tp), 2)
-                    kk = _tp_heads(kk, tp_axis, hkv_loc, 2)
-                    vv = _tp_heads(vv, tp_axis, hkv_loc, 2)
+                # Local head family (see _qkv_local) — it lines up with
+                # the gathered prefix (pk_l/pv_l IS this shard's
+                # kv-head slice of the pool), and the scan ys (kk, vv)
+                # stay local: exactly the rows this shard's pool
+                # scatter stores.
+                q, kk, vv = _qkv_local(cfg, h, blk, angles, (M, tb),
+                                       tp_axis, tp, wsharded)
                 h_kv = kk.shape[2]
                 qg = q.reshape(M, tb, h_kv, g, cfg.head_dim)
                 kf = jnp.concatenate([pk_l, kk], axis=1)  # [M,hp+tb,Hkv,hd]
@@ -1181,15 +1319,10 @@ def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
                 scores = jnp.where(valid[:, None, None], scores, _NEG_INF)
                 probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
                 attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
-                if tp_axis is not None:
-                    # Exact head-axis reassembly ([M, tb, Hkv/tp, g, hd]
-                    # → full kv-major head order — movement only).
-                    attn = jax.lax.all_gather(attn, tp_axis, axis=2,
-                                              tiled=True)
-                x = x + qdot(attn.reshape(M, tb,
-                                          cfg.n_heads * cfg.head_dim),
-                             blk["wo"])
-                x, _ = mlp_sublayer(cfg, x, blk, dropless=True)
+                x = _attn_residual(x, attn, blk["wo"], (M, tb), 2,
+                                   tp_axis, wsharded, combine)
+                x = _mlp_residual(cfg, x, blk, tp_axis, wsharded,
+                                  combine)
                 return x, (kk, vv)
 
             x, (mk, mv) = jax.lax.scan(block, x, (params["blocks"], pk, pv))
@@ -1286,11 +1419,24 @@ class ContinuousBatcher:
     byte-identical to unsharded ones, donation and zero-retrace survive
     the island boundary, and admission / chunked prefill / prefix
     mounting / speculative rewind — all host-side block-table and lens
-    edits — are shard-agnostic and run untouched. Per-chip pool
-    residency scales 1/tp: the scale-UP axis no single chip provides
-    (the fleet tier is the scale-OUT axis). Snapshots stay mesh-agnostic
-    (drain gathers full kv heads), so shed/failover works across
-    replicas of different tp."""
+    edits — are shard-agnostic and run untouched.
+
+    ``weight_sharding=True`` (the default on a tp > 1 mesh) rides the
+    WEIGHTS through those islands Megatron-sliced per the
+    parallel/sharding.py WEIGHT_SPECS table (see the module comment at
+    _gather_weight): column-parallel q/k/v/gate/up compute each shard's
+    head/ffn family directly from a [·, ·/tp] slice, row-parallel
+    o/down combine once per projection — ``tp_combine="all_gather"``
+    (movement-only, byte-identity preserved) or ``"psum"`` (1/tp the
+    row-matmul FLOPs, tolerance-checked). Per-chip HBM then holds 1/tp
+    of every sliced weight next to 1/tp of the pool — the scale-UP axis
+    no single chip provides (the fleet tier is the scale-OUT axis);
+    unsliceable dims fail loudly at construction with the valid tp
+    divisors, and ``weight_sharding=False`` keeps the legacy
+    replicated-weight islands (warn-once + counted). Snapshots stay
+    mesh-agnostic (drain gathers full kv heads; weights never ride a
+    snapshot — targets rebuild them from config), so shed/failover
+    works across replicas of different tp and combine modes."""
 
     def __init__(self, params, cfg: LlamaConfig, n_slots: int = 8,
                  max_len: Optional[int] = None, chunk: int = 8,
@@ -1305,6 +1451,8 @@ class ContinuousBatcher:
                  speculative: bool = False, gamma: int = 4,
                  prefill_attn: Optional[str] = None,
                  donate_decoded: bool = True,
+                 weight_sharding: bool = True,
+                 tp_combine: str = "all_gather",
                  fault_injector=None, tracer=None, clock=None,
                  flight_capacity: int = 256):
         self.params = params
@@ -1459,6 +1607,19 @@ class ContinuousBatcher:
         # speculative rewind are shard-agnostic and run untouched.
         self._mesh = mesh if kv_layout == "paged" else None
         self._tp = 1
+        # Megatron-sliced weights through the islands (the module
+        # comment above _gather_weight): on by default wherever a tp > 1
+        # mesh is attached — each chip then HOLDS and MULTIPLIES only
+        # its 1/tp slice of every projection/MLP weight. The legacy
+        # replicated-weight islands stay behind weight_sharding=False,
+        # warn-once + counted like every other serving downgrade.
+        self._wsharded = False
+        if tp_combine not in ("all_gather", "psum"):
+            raise ValueError(
+                f"tp_combine must be 'all_gather' (movement-only, "
+                f"byte-identical) or 'psum' (partial-product reduce, "
+                f"tolerance-checked), got {tp_combine!r}")
+        self._combine = tp_combine
         if self._mesh is not None:
             if TP_AXIS not in self._mesh.shape:
                 raise ValueError(
@@ -1466,11 +1627,41 @@ class ContinuousBatcher:
                     f"'{TP_AXIS}' axis; got axes "
                     f"{tuple(self._mesh.axis_names)}")
             tp = int(self._mesh.shape[TP_AXIS])
-            if cfg.n_kv_heads % tp:
+            want_ws = bool(weight_sharding) and tp > 1
+            if want_ws and cfg.n_experts > 1:
                 raise ValueError(
-                    f"kv heads ({cfg.n_kv_heads}) not divisible by "
-                    f"tp={tp}: the pool shards on the kv-heads dim")
+                    "weight_sharding covers dense-MLP configs only (MoE "
+                    "expert stacks shard over ep, not tp); pass "
+                    "weight_sharding=False for replicated-weight islands")
+            bad = [("kv heads", cfg.n_kv_heads)] if cfg.n_kv_heads % tp \
+                else []
+            if want_ws and cfg.d_ff % tp:
+                bad.append(("d_ff", cfg.d_ff))
+            if bad:
+                # Fail LOUDLY with the workable widths instead of
+                # silently replicating: a 70B config quietly falling
+                # back to replicated weights is exactly the HBM wall
+                # this engine exists to remove.
+                dims = [cfg.n_kv_heads] + ([cfg.d_ff] if want_ws else [])
+                valid = [d for d in range(1, max(dims) + 1)
+                         if all(v % d == 0 for v in dims)]
+                what = " and ".join(f"{n} ({v})" for n, v in bad)
+                raise ValueError(
+                    f"{what} not divisible by tp={tp}: the pool shards "
+                    f"the kv-heads dim and weight sharding slices the "
+                    f"q/k/v/MLP weights — valid tp divisors for this "
+                    f"config: {valid}")
             self._tp = tp
+            self._wsharded = want_ws
+            if tp > 1 and not want_ws:
+                _note_decode_fallback(
+                    "weights_replicated",
+                    msg=(f"weight_sharding=False on a tp={tp} island: "
+                         f"every chip holds and multiplies the FULL "
+                         f"weight matrices — per-chip weight bytes do "
+                         f"not scale with tp; see tpu_serve_decode_"
+                         f"fallback_total{{reason='weights_replicated'}}"
+                         ))
         if kv_layout == "paged":
             if self.S % page_size:
                 raise ValueError(
@@ -1511,6 +1702,45 @@ class ContinuousBatcher:
             self._kv_pool_dev_bytes = int(sum(
                 a.nbytes for a in (self._k, self._v, self._ks, self._vs)
                 if a is not None) // self._tp)
+            # Megatron-sliced weights: build the per-leaf WEIGHT_SPECS
+            # pytree, land each slice on its chips (per-chip HBM then
+            # holds exactly 1/tp of every sliced matrix — the scale-UP
+            # headroom this PR exists for), and record the per-chip
+            # residency as build-time constants (same contract as
+            # kv_pool_device_bytes: NEVER read live arrays from a
+            # scrape thread). ``weight_sliced`` covers the leaves the
+            # WEIGHT_SPECS table slices — exactly 1/tp by construction;
+            # embed/norms/lm_head stay replicated and ride the total.
+            self._wspecs = None
+            try:
+                from .llama import serving_weight_specs
+
+                wspecs = serving_weight_specs(self.params)
+            except ValueError:                       # MoE tree
+                wspecs = None
+            total_b = sliced_b = 0
+            if wspecs is not None:
+                def _acc(leaf, spec):
+                    nonlocal total_b, sliced_b
+                    n = int(leaf.nbytes)
+                    if TP_AXIS in tuple(spec):
+                        sliced_b += n
+                    total_b += n
+                    return leaf
+
+                _map_weight_tree(self.params, wspecs, _acc)
+            else:
+                total_b = int(sum(a.nbytes
+                                  for a in jax.tree.leaves(self.params)))
+            if self._wsharded:
+                self._wspecs = wspecs
+                self._reshard_params()
+                self._weight_dev_bytes = \
+                    (total_b - sliced_b) + sliced_b // self._tp
+                self._weight_sliced_dev_bytes = sliced_b // self._tp
+            else:
+                self._weight_dev_bytes = total_b
+                self._weight_sliced_dev_bytes = sliced_b
             # Host mirror of the block table; the device copy is uploaded
             # (4 bytes/block — KiBs) only on steps whose admissions/frees
             # changed it, and otherwise donated through decode dispatches
@@ -1611,8 +1841,15 @@ class ContinuousBatcher:
             # bodies; PS_/RE_ are the pool-sharded / replicated specs the
             # shard_map wrapper (_jit_island) binds per operand.
             tp_kw = ({} if self._mesh is None
-                     else dict(tp_axis=TP_AXIS, tp=self._tp))
+                     else dict(tp_axis=TP_AXIS, tp=self._tp,
+                               wsharded=self._wsharded,
+                               combine=self._combine))
             PS_, RE_ = POOL_SPEC, P()
+            # Params island spec: the WEIGHT_SPECS pytree when the
+            # weights ride sliced (each body leaf is then the shard's
+            # [·, ·/tp] slice), replicated otherwise (the PR 12 legacy
+            # layout).
+            W_ = self._wspecs if self._wsharded else RE_
             if self.spec:
                 gm = self.gamma
                 # The verify dispatch replaces the decode chunk: one
@@ -1624,7 +1861,7 @@ class ContinuousBatcher:
                     _verify_chunk_paged_fn(
                         p, cfg, gm, ps, k, v, tbl, lens, last, props,
                         active, k_s=ks, v_s=vs, **tp_kw),
-                    in_specs=(RE_, PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_,
+                    in_specs=(W_, PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_,
                               RE_),
                     out_specs=(PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_,
                                RE_),
@@ -1636,7 +1873,7 @@ class ContinuousBatcher:
                     _decode_chunk_paged_fn(
                         p, cfg, chunk, ps, k, v, tbl, lens, last, active,
                         seed, temp, tk, k_s=ks, v_s=vs, **tp_kw),
-                    in_specs=(RE_, PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_,
+                    in_specs=(W_, PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_,
                               RE_),
                     out_specs=(PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_),
                     donate=(1, 2, 3, 4, 5),
@@ -1648,7 +1885,7 @@ class ContinuousBatcher:
                     p, cfg, ps, k, v, lens, last, slots, pids, ptbl,
                     hlens, tokens, tlens, seed, temp, tk, k_s=ks, v_s=vs,
                     prefill_attn=pfa, **tp_kw),
-                in_specs=(RE_, PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_,
+                in_specs=(W_, PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_,
                           RE_, RE_, RE_, RE_, RE_),
                 out_specs=(PS_, PS_, PS_, PS_, RE_, RE_, RE_),
                 donate=(1, 2, 3, 4),
@@ -1707,6 +1944,24 @@ class ContinuousBatcher:
             # graftcheck: ignore[host-sync] — sanctioned: same placement boundary (scale planes)
             self._ks = jax.device_put(self._ks, sh)
             self._vs = jax.device_put(self._vs, sh)  # graftcheck: ignore[host-sync] — sanctioned: same placement boundary
+
+    def _reshard_params(self) -> None:
+        """Land the params pytree on the island's WEIGHT_SPECS placement
+        (models/llama.py serving_weight_specs): column slices on their
+        output axis, row slices on their input axis, everything else
+        replicated — after this put each chip's HBM holds only its 1/tp
+        slice of every projection/MLP weight, which is the whole point.
+        Engine birth only (params never change afterwards); jit keys on
+        the committed shardings, so every dispatch reuses one program
+        with zero per-call weight movement beyond the declared
+        combines."""
+        sh = partial(NamedSharding, self._mesh)
+
+        def put(leaf, spec):
+            # graftcheck: ignore[host-sync] — sanctioned: engine-birth weight placement (never in the step loop)
+            return jax.device_put(leaf, sh(spec))
+
+        self.params = _map_weight_tree(self.params, self._wspecs, put)
 
     def _pin_host_state(self) -> None:
         """Commit ``lens``/``last`` replicated onto the island mesh. jit
@@ -2869,7 +3124,13 @@ class ContinuousBatcher:
         recorded at all: drain gathers the full kv-head dim to host, so
         a snapshot is mesh-agnostic by construction and restores across
         heterogeneous replicas (tp=2 → tp=1 → tp=4) — the fleet
-        shed/failover story across mixed replica shapes depends on it. ``prefill_chunk_tokens`` is deliberately NOT
+        shed/failover story across mixed replica shapes depends on it.
+        ``weight_sharding`` and ``tp_combine`` are likewise excluded:
+        weights never ride a snapshot (they are rebuilt from config by
+        whoever constructs the target engine), and how a replica slices
+        or combines them changes no pool byte and no stream — a
+        weight-sharded tp=4 replica absorbs a replicated tp=2 shed
+        unchanged. ``prefill_chunk_tokens`` is deliberately NOT
         part of the contract: chunking is a pure scheduling knob — a
         chunked engine's mid-prefill snapshot restores into an unchunked
         one (the tail prefills in one dispatch) and vice versa, with no
@@ -3400,6 +3661,11 @@ class ContinuousBatcher:
             # carries it so operators can see which replicas scale UP
             # vs OUT.
             "tp": self._tp,
+            # Per-chip weight residency (Megatron-sliced weights): the
+            # capacity axis that tells a scale-UP replica — one that
+            # actually fits big weights per chip — from a replicated-
+            # weight one at the same tp.
+            "weight_device_bytes": int(self._weight_dev_bytes),
         }
 
     def cache_digest(self, top_k: int = 8,
@@ -3475,6 +3741,19 @@ class ContinuousBatcher:
         # 1/tp scaling on this gauge.
         out["tp"] = float(self._tp)
         out["kv_pool_device_bytes"] = float(self._kv_pool_dev_bytes)
+        # Megatron-sliced weights: per-chip weight residency (total and
+        # the WEIGHT_SPECS-sliced subset — the latter is exactly 1/tp by
+        # construction, the sharded_weights bench's CI assertion). Both
+        # are engine-build-time constants like kv_pool_device_bytes:
+        # the weights are live jit operands and a scrape thread must
+        # never touch them. ``tp_combine`` is the info-style combine
+        # label (exporter: tpu_serve_tp_combine{kind=} = 1).
+        out["weight_device_bytes"] = float(self._weight_dev_bytes)
+        out["weight_sliced_device_bytes"] = \
+            float(self._weight_sliced_dev_bytes)
+        out["tp_combine"] = (self._combine if self._wsharded
+                             else ("replicated" if self._tp > 1
+                                   else "none"))
         # ONE lock snapshot for everything the step loop mutates: the
         # watchdog age, the spec gauges and the drained phase batch all
         # come from the same instant, so a scrape racing a step can

@@ -43,7 +43,13 @@ trace of the source engine's tp width and the fingerprint deliberately
 omits it — restore/absorb re-shard the pages onto the TARGET's mesh
 (serving._reshard_pool), which is what lets the fleet shed/failover
 across heterogeneous replicas (tp=2 → tp=1 → tp=4 round trips are
-token-identical, tests/test_sharded_serving.py).
+token-identical, tests/test_sharded_serving.py). Model WEIGHTS are
+likewise never part of a snapshot — whoever constructs the target
+engine rebuilds them from config — so how a replica slices them
+(serving ``weight_sharding``/``tp_combine``, Megatron column/row specs)
+is invisible to the payload and to the fingerprint: a psum tp=2 drain
+restores onto an all_gather tp=4 engine, or a legacy replicated one,
+with no format work (pinned by the cross-combine round-trip test).
 
 The snapshot runs through ``utils/checkpoint.py``'s orbax machinery via
 ``to_pytree``/``from_pytree``: every field becomes a numpy array (the

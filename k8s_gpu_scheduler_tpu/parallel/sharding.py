@@ -76,6 +76,44 @@ DEFAULT_RULES: Dict[str, object] = {
 KV_POOL_AXES: Tuple[str, ...] = ("layers", "pages", "page", "kv_heads",
                                  "head_dim")
 
+# Megatron-sliced SERVING weights (models/serving.py weight_sharding):
+# param-leaf name → slice kind over the layer-stacked [L, K, N] matmul
+# view. "column" shards the OUTPUT axis N (q/k/v and MLP gate/up — each
+# chip computes its own contiguous head/ffn family directly, no
+# combine); "row" shards the INPUT axis K (o and MLP down — the shard
+# contracts its 1/tp slice and a per-block combine reassembles:
+# all_gather the weight+activation for a movement-only byte-identical
+# result, or psum the partial products for less compute/traffic).
+# Everything not named here (embed, norms, lm_head) replicates. The
+# serving engine BUILDS its per-leaf PartitionSpecs from this table
+# (models/llama.py serving_weight_specs) and the graftcheck GSPMD/
+# traffic audits derive their expected island mappings from it, so the
+# runtime and the guard rails cannot drift.
+WEIGHT_SPECS: Dict[str, str] = {
+    "wq": "column",
+    "wk": "column",
+    "wv": "column",
+    "w_gate": "column",
+    "w_up": "column",
+    "wo": "row",
+    "w_down": "row",
+}
+# Axis index of the slice inside the stacked [L, K, N] serving layout.
+WEIGHT_COLUMN_DIM, WEIGHT_ROW_DIM = 2, 1
+
+
+def weight_slice_spec(kind: str, rules: Dict[str, object] = None) -> P:
+    """PartitionSpec of one stacked [L, K, N] serving weight for a
+    WEIGHT_SPECS kind — the tp mesh axis comes from the SAME rules-table
+    entry the pool derives its kv-heads mapping from."""
+    rules = rules or DEFAULT_RULES
+    tp = rules["kv_heads"]
+    if kind == "column":
+        return P(None, None, tp)
+    if kind == "row":
+        return P(None, tp, None)
+    raise ValueError(f"unknown weight slice kind {kind!r}")
+
 
 def logical_axis_rules(overrides: Dict[str, object] = None) -> Dict[str, object]:
     rules = dict(DEFAULT_RULES)

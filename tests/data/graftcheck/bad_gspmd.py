@@ -13,7 +13,11 @@ memory/ICI at scale, and none of it is visible to the AST pass:
   with no sharding constraint anywhere in the program
   (``unconstrained-scan-carry`` — GSPMD free-propagates through the
   loop, typically replicating the biggest buffer in the program onto
-  every chip).
+  every chip);
+- ``bad_replicated_weight_island`` registers a weight-sharded island
+  (``weight_specs=True``) whose [L, K, N] weight operand rides UNMAPPED
+  — the replicated-weight layout Megatron slicing retires
+  (``island-weight-spec``: per-chip weight bytes do not scale 1/tp).
 
 The mesh is built at whatever device count the process has (axis sizes
 clamp to 1), because the ANNOTATIONS — all this audit reads — are
@@ -23,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_gpu_scheduler_tpu.parallel.sharding import shard_map
 
 
 def _mesh():
@@ -49,6 +55,20 @@ def _bad_scan_carry(x):
     return out
 
 
+def _bad_replicated_weight_island(pool, w):
+    # Pool correctly mapped on the kv-heads dim — the island is fine on
+    # that axis — but the weight rides replicated (unmapped): every
+    # chip holds and multiplies the full matrix.
+    fn = shard_map(
+        lambda p, w: (p * 2.0, (p.sum(axis=(0, 1, 2, 4)) @ w).sum()),
+        mesh=_mesh(),
+        in_specs=(P(None, None, None, "tp", None), P()),
+        out_specs=(P(None, None, None, "tp", None), P()),
+        check_vma=False)
+    new_pool, s = fn(pool, w)
+    return new_pool.sum() + s
+
+
 GRAFTCHECK_GSPMD_AUDIT = [
     ("bad_cache_constraint", _bad_cache_constraint,
      (jnp.zeros((2, 2, 32, 8, 8), jnp.bfloat16),
@@ -56,4 +76,8 @@ GRAFTCHECK_GSPMD_AUDIT = [
      {"cache_spec": True}),
     ("bad_scan_carry", _bad_scan_carry,
      (jnp.zeros((2, 64, 1024), jnp.float32),), {}),
+    ("bad_replicated_weight_island", _bad_replicated_weight_island,
+     (jnp.zeros((2, 4, 8, 8, 8), jnp.bfloat16),
+      jnp.zeros((2, 8, 16), jnp.bfloat16)),
+     {"pool_spec": True, "weight_specs": True}),
 ]

@@ -14,20 +14,29 @@ residency at scale, and none is visible to the AST or recompile passes:
   survive the update — a 2× pool high-water (``peak-residency``), the
   silently-broken-donation shape;
 - ``no_contract`` registers with ``None`` — a serving-shaped entry
-  whose complexity class was never declared (``traffic-contract``).
+  whose complexity class was never declared (``traffic-contract``);
+- ``replicated_weight_island`` declares ``weight_sharded`` but ships
+  the FULL [L, d, d] weight into its shard_map island — the
+  replicated-weight layout whose per-chip bytes do not scale 1/tp
+  (``traffic-contract``, the Megatron-slicing regression seed).
 
 Geometry values are mutually distinct for every scale symbol, per the
 registry convention (TRAFFIC_GEOMETRY).
 """
+import jax
 import jax.numpy as jnp
 import numpy as np
+
+from k8s_gpu_scheduler_tpu.parallel.sharding import shard_map
 
 L, N_PAGES, PS, HKV, HD = 2, 11, 4, 3, 7
 M, HB = 5, 2
 HIT = HB * PS                              # 8
+D, DFF = 6, 13                             # full-weight dims (d, d_ff)
 
 GEOMETRY = {"n_pages": N_PAGES, "hit": HIT, "M": M,
-            "L": L, "Hkv": HKV, "hd": HD, "ps": PS}
+            "L": L, "Hkv": HKV, "hd": HD, "ps": PS,
+            "d": D, "d_ff": DFF}
 
 _POOL = jnp.zeros((L, N_PAGES, PS, HKV, HD), jnp.float32)
 _TBL = np.tile(np.asarray([[1, 2]], np.int32), (M, 1))    # [M, HB]
@@ -51,10 +60,27 @@ def _no_contract(pool):
     return pool.sum()
 
 
+def _replicated_weight_island(pool, w):
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    fn = shard_map(
+        lambda p, w: (p * 2.0, (w * 1.0).sum()),
+        mesh=mesh,
+        in_specs=(P(None, None, None, "tp", None), P()),
+        out_specs=(P(None, None, None, "tp", None), P()),
+        check_vma=False)
+    new_pool, s = fn(pool, w)
+    return new_pool.sum() + s
+
+
 GRAFTCHECK_TRAFFIC_AUDIT = [
     ("bad_dense_gather", _dense_gather, (_POOL, _TBL), GEOMETRY,
      {"kv_scale": {"tb": 1}, "donated": (0,)}),
     ("bad_broken_donation", _broken_donation, (_POOL, _ROW), GEOMETRY,
      {"kv_scale": {}, "donated": (0,)}),
     ("bad_no_contract", _no_contract, (_POOL,), GEOMETRY, None),
+    ("bad_replicated_weight_island", _replicated_weight_island,
+     (_POOL, jnp.zeros((L, D, D), jnp.float32)), GEOMETRY,
+     {"kv_scale": {}, "weight_sharded": True}),
 ]

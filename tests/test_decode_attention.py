@@ -278,6 +278,8 @@ class TestInterpretToggle:
 
 
 class TestBenchLeg:
+    @pytest.mark.slow  # the dedicated CI step runs the same leg every
+    # push (the PR 5 convention for bench smokes with their own CI step)
     def test_decode_attention_microbench_smoke(self):
         """`bench.py --leg decode_attention --smoke` must emit ONE JSON
         line with dense-vs-fused tokens/s for both cache dtypes — the

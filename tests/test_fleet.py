@@ -389,6 +389,11 @@ class TestShedMigration:
 
 # -- router end to end -----------------------------------------------------
 class TestRouterEndToEnd:
+    @pytest.mark.slow  # double-covered (PR 15 budget): the fleet bench
+    # CI step drives the same forced-shed e2e (token identity vs the
+    # single-engine reference + migration counters) on every push, and
+    # test_shed_is_token_identical_and_consistent keeps the shed
+    # machinery tier-1.
     def test_fleet_run_with_forced_shed_token_identity(self, setup):
         cfg, params = setup
         prompts, _ = mk_workload(cfg, n=12, n_classes=3)
@@ -939,6 +944,10 @@ class TestCrashFailover:
         assert st["failovers"] == 1 and st["requests_lost"] == 0
         assert st["replayed_tokens"] == 0          # nothing delivered yet
 
+    @pytest.mark.slow  # double-covered (PR 15 budget): the fleet_chaos
+    # bench CI step kills replicas mid-trace and asserts zero loss +
+    # byte identity + bounded replay on every push; the prefill-crash
+    # and journal-restart cells keep the failover machinery tier-1.
     def test_crash_mid_decode_verifies_and_streams_suffix(self, setup):
         """Kill a replica mid-decode: replay re-decodes only the verify
         window (bounded rework) and the final stream is
@@ -1014,6 +1023,10 @@ class TestCrashFailover:
         st = router.stats()
         assert st["failovers"] == 2 and st["requests_lost"] == 0
 
+    @pytest.mark.slow  # double-covered (PR 15 budget): the health-
+    # ladder/breaker unit tests keep quarantine→rejoin logic tier-1 and
+    # the fleet_chaos bench CI step runs a rejoining engine_factory
+    # through seeded kills on every push.
     def test_quarantined_replica_rejoins_and_serves_again(self, setup):
         cfg, params = setup
         prompts, _ = mk_workload(cfg, n=6, seed=7)

@@ -273,6 +273,42 @@ class TestServingPoolExport:
         assert (f'{PREFIX_HIT_HISTOGRAM}_count{{replica="r0"}} 1'
                 in reg2.expose())
 
+    def test_weight_gauges_and_tp_combine_info(self):
+        """Megatron-sliced weights' metrics surface: per-chip weight
+        residency gauges (build-time constants, the kv_pool contract)
+        and the tpu_serve_tp_combine{kind=} info metric — 1 under the
+        engine's combine label, never a raw string into a gauge; the
+        unlabeled exposition stays byte-identical for callers that
+        publish no combine key."""
+        from k8s_gpu_scheduler_tpu.metrics import export_serving_pool
+        from k8s_gpu_scheduler_tpu.metrics.exporter import (
+            SERVING_POOL_GAUGES, TP_COMBINE_INFO,
+        )
+
+        assert "weight_device_bytes" in SERVING_POOL_GAUGES
+        assert "weight_sliced_device_bytes" in SERVING_POOL_GAUGES
+        reg = Registry()
+        export_serving_pool(reg, {
+            "weight_device_bytes": 148096.0,
+            "weight_sliced_device_bytes": 81920.0,
+            "tp_combine": "all_gather",
+        })
+        text = reg.expose()
+        assert "tpu_serve_weight_device_bytes 148096.0" in text
+        assert "tpu_serve_weight_sliced_device_bytes 81920.0" in text
+        assert f'{TP_COMBINE_INFO}{{kind="all_gather"}} 1.0' in text
+        # Labeled (fleet) edition rides the same machinery.
+        reg2 = Registry()
+        export_serving_pool(reg2, {"tp_combine": "psum"},
+                            labels={"replica": "r0"})
+        assert (f'{TP_COMBINE_INFO}{{kind="psum",replica="r0"}} 1.0'
+                in reg2.expose())
+        # No combine key (contiguous engines / old callers): no
+        # tp_combine series at all — exposition unchanged.
+        reg3 = Registry()
+        export_serving_pool(reg3, {"pages_free": 1.0})
+        assert TP_COMBINE_INFO not in reg3.expose()
+
     def test_replica_labeled_export_and_unlabeled_byte_identity(self):
         """The fleet tier publishes each replica under {replica=}: the
         labeled series ride the SAME gauges/histogram, and a caller
@@ -591,6 +627,10 @@ class TestPhaseHistograms:
         # And the special key never leaks as a gauge.
         assert "tpu_serve_phase_durations" not in text
 
+    @pytest.mark.slow  # double-covered (PR 15 budget): graftcheck pass
+    # 10's torn-snapshot rule guards this class STATICALLY in tier-1
+    # (make lint + test_graftcheck_clean); the concurrent hammer rides
+    # the unfiltered CI run.
     def test_pool_metrics_atomic_snapshot_regression(self):
         """The torn-read bugfix: tpu_serve_last_step_age_seconds, the
         spec gauges and the phase batch all come from ONE lock snapshot

@@ -207,11 +207,13 @@ class TestBatcherFuzz:
 
     cfg = TestServing.f32_cfg()
 
-    # Two seeds in tier-1 keep the fuzz signal inside the wall-clock
-    # budget; the full six-seed sweep runs in the unfiltered CI suite.
+    # One seed in tier-1 keeps the fuzz signal inside the wall-clock
+    # budget (PR 15 trimmed the second — the seeds are interchangeable
+    # probes of one property); the full six-seed sweep runs in the
+    # unfiltered CI suite.
     @pytest.mark.parametrize("seed", [
-        0, 1,
-        *(pytest.param(s, marks=pytest.mark.slow) for s in range(2, 6)),
+        0,
+        *(pytest.param(s, marks=pytest.mark.slow) for s in range(1, 6)),
     ])
     def test_random_schedule_matches_static_generate(self, seed):
         import numpy as np
@@ -876,6 +878,12 @@ class TestPipelineParallel:
             params, state, loss = step(params, state, batch)
         assert float(loss) < float(first)
 
+    @pytest.mark.slow  # double-covered (PR 15 budget), transitively:
+    # test_1f1b_train_step_matches_gpipe (1f1b == gpipe) and
+    # test_pp_train_step_decreases_loss_and_matches_dense_step
+    # (gpipe == dense) stay tier-1, so a 1f1b wiring bug still fails
+    # tier-1; this direct per-(M, remat) grads sweep rides the
+    # unfiltered CI run.
     def test_1f1b_loss_and_grads_match_single_device(self):
         """The manual-VJP 1F1B schedule (pp_1f1b_loss_and_grads) must
         reproduce the single-device loss AND every parameter gradient —

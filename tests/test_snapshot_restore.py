@@ -97,12 +97,15 @@ class TestTokenIdentity:
     other engine grid in this suite."""
 
     @pytest.mark.parametrize("impl,dtype,kvd,prefix,spec", [
-        ("dense", jnp.float32, None, False, False),
-        # Tier-1 keeps the dense reference + the RICHEST production
-        # cell (fused-int8 WITH prefix+spec); the fused-int8-plain and
-        # dense-prefix cells are covered by that superset and ride the
-        # slow marker (the fleet PR's tier-1 additions paid for their
-        # wall-clock here — unfiltered CI still runs every cell).
+        # Tier-1 keeps the RICHEST production cell (fused-int8 WITH
+        # prefix+spec); every other cell — including the dense-f32
+        # reference since the PR 15 budget pass — is covered by that
+        # superset plus the chaos bench CI step (drain→restore identity
+        # every push) and rides the slow marker (the fleet PR's tier-1
+        # additions paid for their wall-clock here — unfiltered CI
+        # still runs every cell).
+        pytest.param("dense", jnp.float32, None, False, False,
+                     marks=pytest.mark.slow),
         pytest.param("fused", jnp.bfloat16, "int8", False, False,
                      marks=pytest.mark.slow),
         pytest.param("dense", jnp.float32, None, True, False,

@@ -225,14 +225,16 @@ class TestSpeculativeEngine:
         done = eng.run()
         return [done[i] for i in ids], eng
 
-    # f32 grid: the all-reference (dense, bf16-free pool) and the
-    # all-production (fused, int8) corners stay in tier-1; the mixed
-    # cells ride the unfiltered CI suite (budget note on the bf16 grid).
+    # f32 grid: the all-reference (dense, bf16-free pool) corner stays
+    # in tier-1; the mixed cells AND the f32 fused-int8 corner ride the
+    # unfiltered CI suite (budget note on the bf16 grid — the bf16
+    # fused-int8 cell below is the production combination and keeps
+    # that corner tier-1; PR 15 budget).
     @pytest.mark.parametrize("impl,kvd", [
         ("dense", None),
         pytest.param("dense", "int8", marks=pytest.mark.slow),
         pytest.param("fused", None, marks=pytest.mark.slow),
-        ("fused", "int8"),
+        pytest.param("fused", "int8", marks=pytest.mark.slow),
     ])
     def test_spec_matches_greedy_paged_f32(self, impl, kvd):
         cfg = self._cfg(decode_attn=impl)
