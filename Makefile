@@ -53,6 +53,7 @@ bench-smoke:
 	$(PY) bench.py --leg sharded_decode --smoke
 	$(PY) bench.py --leg sharded_weights --smoke
 	$(PY) bench.py --leg multiturn --smoke
+	$(PY) bench.py --leg kv_tiering --smoke
 	$(PY) bench.py --leg decode_attention --smoke
 
 demo: native

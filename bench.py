@@ -2235,6 +2235,163 @@ def bench_multiturn(smoke=False):
     }
 
 
+def bench_kv_tiering(smoke=False):
+    """KV-tiering leg — the host-DRAM second tier under the radix tree,
+    measured end-to-end: N distinct conversations sized so their cached
+    pages OVERFLOW the HBM page pool but FIT the DRAM tier, driven twice
+    over the SAME two-turn trace — tiering on (evicted pages demote to
+    DRAM, turn 2 promotes them back ahead of prefill) and tiering off
+    (eviction forgets the pages, turn 2 re-prefills cold). Greedy
+    streams must be identical across both configs (tiering must never
+    change an answer), the tiering-on pass must be zero-retrace under a
+    RecompileGuard (promotion re-uploads land in fresh pool pages
+    BEFORE the prefill dispatch, so the compiled rungs never see the
+    tier), the measured request hit rate with tiering on must strictly
+    beat the tiering-off ceiling (the pool is too small for resident
+    reuse alone — that gap IS the feature), and the promoted-path
+    turn-2 TTFT p50 must strictly beat the tiering-off turn-2 TTFT p50
+    over the same prompts (re-upload must be cheaper than re-prefill).
+    On CPU (or --smoke) the model is tiny/f32; the TPU run under the
+    driver is what BENCH_*.json captures."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_scheduler_tpu.analysis.recompile import RecompileGuard
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if smoke or not on_tpu:
+        # f32: the identity assert must see no bf16 near-tie noise.
+        cfg = dataclasses.replace(LlamaConfig.tiny(),
+                                  decode_attn="fused", dtype=jnp.float32)
+        n_conv, p_len, turn_new = 6, 60, 8
+        eng_kw = dict(n_slots=2, max_len=128, chunk=2, prefill_bucket=8,
+                      page_size=8, n_pages=20)
+        dram_pages = 64
+    else:
+        cfg = LlamaConfig(
+            vocab=32000, d_model=1024, n_layers=4, n_heads=16,
+            n_kv_heads=16, d_ff=4096, max_seq=4096, remat=False,
+            decode_attn="fused")
+        n_conv, p_len, turn_new = 16, 512, 64
+        eng_kw = dict(n_slots=4, max_len=4096, chunk=16,
+                      prefill_bucket=64, page_size=64, n_pages=48)
+        dram_pages = 256
+    # Corpus sizing invariant the leg depends on: every conversation's
+    # cached pages together overflow the pool (turn 2 cannot be served
+    # from residency) but fit the DRAM tier (nothing spills to disk).
+    ps = eng_kw["page_size"]
+    conv_pages = (p_len + turn_new) // ps
+    assert n_conv * conv_pages > eng_kw["n_pages"], "corpus fits the pool"
+    assert n_conv * conv_pages <= dram_pages, "corpus overflows the tier"
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def drive(tiering, guard=None):
+        """Two turns over the same conversation corpus. Returns
+        (replies per conv, engine, warm-pass metric snapshot, wall
+        seconds, turn-1 request metrics, turn-2 request metrics)."""
+        tier_kw = dict(kv_tiering=True, dram_pages=dram_pages) \
+            if tiering else {}
+        eng = ContinuousBatcher(params, cfg, kv_dtype="int8",
+                                kv_layout="paged", prefix_cache=True,
+                                **tier_kw, **eng_kw)
+        # Warm pass: ONE extra conversation walks both turns' prefill
+        # rungs (cold full-prompt bucket + hit-suffix bucket — resident
+        # and promoted hits share the same page arithmetic, hence the
+        # same compiled shapes) outside the measured window.
+        wrng = np.random.default_rng(99)
+        transcript = []
+        for turn in range(2):
+            prompt = transcript + list(
+                wrng.integers(0, cfg.vocab, p_len if turn == 0
+                              else turn_new))
+            eng.submit(prompt, max_new=turn_new)
+            done = {}
+            while eng.pending:
+                done.update(eng.step())
+            (_, toks), = done.items()
+            transcript = prompt + toks
+        eng.pop_request_metrics()
+        warm = eng.pool_metrics()
+        if guard is not None:
+            guard.track("decode", eng._decode)
+            guard.track("prefill", eng._prefill)
+            guard.snapshot()
+        rngs = [np.random.default_rng(1000 + c) for c in range(n_conv)]
+        prompts = [list(r.integers(0, cfg.vocab, p_len)) for r in rngs]
+        replies = [[] for _ in range(n_conv)]
+        met_by_turn = []
+        t0 = time.perf_counter()
+        for turn in range(2):
+            rids = {}
+            for c in range(n_conv):
+                prompt = prompts[c] if turn == 0 else (
+                    prompts[c] + replies[c][0]
+                    + list(rngs[c].integers(0, cfg.vocab, turn_new)))
+                rids[eng.submit(prompt, max_new=turn_new)] = c
+            done = {}
+            while eng.pending:
+                done.update(eng.step())
+            for rid, c in rids.items():
+                replies[c].append(done[rid])
+            met_by_turn.append(eng.pop_request_metrics())
+        wall = time.perf_counter() - t0
+        eng._alloc.assert_consistent()
+        return replies, eng, warm, wall, met_by_turn[0], met_by_turn[1]
+
+    guard = RecompileGuard()
+    rep_on, eng_on, warm_on, wall_on, met_cold, met_warm = \
+        drive(True, guard)
+    retraces = sum(guard.misses_since().values())
+    rep_off, eng_off, warm_off, wall_off, _, met_off2 = drive(False)
+    identity = rep_on == rep_off
+
+    m_on, m_off = eng_on.pool_metrics(), eng_off.pool_metrics()
+
+    def window_hit_rate(m, warm):
+        hits = m["prefix_lookup_hits"] - warm["prefix_lookup_hits"]
+        lookups = m["prefix_lookups"] - warm["prefix_lookups"]
+        return hits / lookups if lookups else 0.0
+
+    promoted = sum(m_on.get("promoted_hit_token_batch") or ())
+    total_tokens = n_conv * 2 * turn_new
+    extra = {
+        "kv_tiering_shape": f"{n_conv} convs x 2 turns (prompt {p_len}, "
+                            f"{turn_new} new/turn), pool "
+                            f"{eng_kw['n_pages']}p + dram {dram_pages}p",
+        "kv_tiering_interpret": not on_tpu,
+        "kv_tiering_token_identity": bool(identity),
+        "kv_tiering_retraces": int(retraces),
+        "kv_tiering_hit_rate_on": round(window_hit_rate(m_on, warm_on), 3),
+        "kv_tiering_hit_rate_off": round(
+            window_hit_rate(m_off, warm_off), 3),
+        "kv_tiering_demotions": int(
+            m_on["page_demotions_total"]
+            - warm_on["page_demotions_total"]),
+        "kv_tiering_promotions": int(
+            m_on["page_promotions_total"]
+            - warm_on["page_promotions_total"]),
+        "kv_tiering_promoted_hit_tokens": int(promoted),
+        "kv_tiering_dram_pages": int(m_on["tier_dram_pages"]),
+        "kv_tiering_tok_s_on": round(total_tokens / wall_on, 1),
+        "kv_tiering_tok_s_off": round(total_tokens / wall_off, 1),
+    }
+    extra.update(_latency_stats(met_warm, prefix="kv_tiering_warm_"))
+    extra.update(_latency_stats(met_cold, prefix="kv_tiering_cold_"))
+    extra.update(_latency_stats(met_off2, prefix="kv_tiering_off_turn2_"))
+    return {
+        "metric": "kv_tiering_bench",
+        "value": extra["kv_tiering_warm_ttft_p50_ms"],
+        "unit": "ms_warm_ttft_p50",
+        "extra": extra,
+    }
+
+
 def bench_sharded_decode(smoke=False, tp=2):
     """Multi-chip sharded paged serving (shard_map islands over tp) on
     FORCED host devices: the same open-loop workload through an
@@ -2540,11 +2697,15 @@ def main(argv=None):
         if leg == "multiturn":
             print(json.dumps(bench_multiturn(smoke="--smoke" in args)))
             return
+        if leg == "kv_tiering":
+            print(json.dumps(bench_kv_tiering(smoke="--smoke" in args)))
+            return
         raise SystemExit(f"unknown bench leg: {leg!r} (available: "
                          f"decode_attention, paged_attention, prefix_cache, "
                          f"speculative, analysis, chaos, obs_overhead, "
                          f"fleet, fleet_chaos, chunked_prefill, "
-                         f"sharded_decode, sharded_weights, multiturn)")
+                         f"sharded_decode, sharded_weights, multiturn, "
+                         f"kv_tiering)")
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —
     # the bench measures the scheduler as deployed.
     sys.setswitchinterval(0.001)

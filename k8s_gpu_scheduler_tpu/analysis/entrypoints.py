@@ -276,6 +276,16 @@ def traffic_contracts() -> Dict[str, "object"]:
                       "row) — the island edition carries the same "
                       "sanction",
             donated=(1, 2, 3, 4), tp=2, weight_sharded=True),
+        # KV-tier promotion upload (serving.scatter_pool_pages — the ONE
+        # page-relocation primitive, shared with snapshot restore): the
+        # payload is O(promoted pages) (a constant in this geometry —
+        # the page count is deliberately NOT a tracked symbol value),
+        # and the only pool-scale values are the .at[idx].set update
+        # chain itself — no full-pool dequant/transpose intermediates.
+        # Pool planes (args 0-3) are donated: each old plane dies at its
+        # own scatter, so peak residency stays at one pool working set.
+        "traffic_promote_upload": TrafficContract(
+            kv_scale={}, donated=(0, 1, 2, 3)),
         # The LEGACY replicated-weight island (weight_sharding=False)
         # keeps a contract row of its own: same traffic classes, NO
         # weight_sharded check — and the tests pin that auditing it
@@ -335,6 +345,7 @@ _TRAFFIC_ENTRIES: Tuple[Tuple[str, dict], ...] = (
      {"kind": "prefill", "hb": 4, "attn": "kernel"}),
     ("traffic_prefill_tb16_hb4_gather",
      {"kind": "prefill", "hb": 4, "attn": "gather"}),
+    ("traffic_promote_upload", {"kind": "promote"}),
     ("traffic_decode_chunk_tp2", {"kind": "decode", "tp": True}),
     ("traffic_decode_chunk_tp2_psum",
      {"kind": "decode", "tp": True, "combine": "psum"}),
@@ -354,6 +365,25 @@ def _make_traffic_build(kind: str, hb: int = 0, attn=None,
                         tp: bool = False, ws: bool = True,
                         combine: str = "all_gather") -> Callable[[], tuple]:
     def build():
+        if kind == "promote":
+            # The tier promotion upload: the REAL relocation primitive
+            # (serving.scatter_pool_pages), payloads shaped like a
+            # 7-page promotion — 7 collides with no geometry symbol
+            # value, so the moved-pages dim is a CONSTANT and anything
+            # scale-bearing beyond the pool update chain is a finding.
+            from ..models import serving
+
+            eng = _traffic_engine()
+            P = 7
+            idx = np.arange(1, 1 + P, dtype=np.int32)
+
+            def pay(pool):
+                shape = tuple(pool.shape)
+                return np.zeros((shape[0], P) + shape[2:], np.float32)
+
+            return serving.scatter_pool_pages, (
+                eng._k, eng._v, eng._ks, eng._vs, idx,
+                pay(eng._k), pay(eng._v), pay(eng._ks), pay(eng._vs))
         if kind == "decode":
             eng = _traffic_engine(tp=tp, weight_sharding=ws,
                                   tp_combine=combine)
@@ -731,6 +761,62 @@ def _prefix_kernel_multiturn_scenario() -> tuple:
     return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
 
 
+def _paged_tiered_batcher_scenario() -> tuple:
+    """KV-tiering edition of the prefix scenario: the pool (10 pages) is
+    deliberately too small for the working set, so every steady wave
+    runs a full demote→promote cycle — a fresh 28-token miss whose
+    admission LRU-evicts cached leaves INTO the host-DRAM tier (the
+    step-boundary readback drain), then a re-submission of an earlier
+    prompt whose match extends through the demoted nodes and re-uploads
+    them ahead of the tail prefill. By design still one compiled program
+    per rung: demotion is a host-side device_get (no dispatch at all),
+    the promotion upload is the eager scatter_pool_pages relocation
+    (audited separately by the traffic registry), and the prefill/decode
+    rungs see the same (tb, hb) buckets every wave — page ids, tier keys
+    and payload bytes vary in CONTENT only. Pool + table keep riding the
+    donation chain throughout."""
+    import dataclasses
+
+    from ..models.serving import ContinuousBatcher
+
+    cfg, params = _tiny()
+    eng = ContinuousBatcher(params, dataclasses.replace(cfg,
+                                                        decode_attn="fused"),
+                            n_slots=2, max_len=64, chunk=2,
+                            prefill_bucket=8, kv_dtype="int8",
+                            kv_layout="paged", page_size=8, n_pages=10,
+                            prefix_cache=True, kv_tiering=True,
+                            dram_pages=32)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(0, cfg.vocab, 28)) for _ in range(7)]
+
+    def turn(p):
+        eng.submit(p, max_new=8)
+        eng.run()
+
+    def warmup():
+        # Three distinct misses overflow the pool (demotions begin at
+        # the third admission), then the first prompt returns through
+        # the tier: the promote + tail-prefill (hb) rung compiles here.
+        for p in prompts[:3]:
+            turn(p)
+        turn(prompts[0])
+
+    def wave(i: int):
+        def go():
+            before = eng.pool_metrics()["page_promotions_total"]
+            turn(prompts[3 + i])     # fresh miss → demotion pressure
+            turn(prompts[1 + i])     # demoted path → promote + hit rung
+            # A wave that stopped cycling the tier would make this
+            # zero-retrace audit vacuous — fail loudly instead.
+            assert eng.pool_metrics()["page_promotions_total"] > before, \
+                "tiered wave served no promoted hit"
+        return go
+
+    steady = [wave(0), wave(1), wave(2)]
+    return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
+
+
 def _paged_chunked_batcher_scenario() -> tuple:
     """Chunked-prefill edition of the paged scenario: a long prompt's
     budgeted prefill CHUNKS interleave with live decode traffic across
@@ -883,6 +969,7 @@ def recompile_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
         ("batcher_steady_decode_paged_traced",
          _paged_traced_batcher_scenario),
         ("batcher_steady_decode_paged_prefix", _paged_prefix_batcher_scenario),
+        ("batcher_steady_decode_paged_tiered", _paged_tiered_batcher_scenario),
         ("batcher_steady_decode_paged_spec", _paged_spec_batcher_scenario),
         ("batcher_steady_mixed_chunked", _paged_chunked_batcher_scenario),
         ("batcher_steady_decode_paged_tp", _sharded_paged_batcher_scenario),
@@ -1111,6 +1198,69 @@ def _alias_prefill_kernel_scenario() -> tuple:
     return eng._prefill, args, (1, 2, 3, 4), (0, 1, 2, 3), shared
 
 
+def _alias_promoted_scenario() -> tuple:
+    """A decode chunk over a block table whose mounted prefix pages came
+    back through a DRAM demote→promote round trip: build time verifies
+    the promoted pages hold exactly the originally-donated bytes (the
+    relocation is byte-exact end to end — readback, host tier, re-upload
+    into FRESH page ids), and the audit's byte-compare then proves the
+    next dispatch leaves them untouched. The copy-on-write contract
+    covers tier-promoted pages with no carve-out: they are shared tree
+    pages like any other."""
+    import dataclasses
+
+    import jax
+
+    from ..models.serving import ContinuousBatcher
+
+    cfg, params = _tiny()
+    eng = ContinuousBatcher(params, dataclasses.replace(cfg,
+                                                        decode_attn="fused"),
+                            n_slots=2, max_len=64, chunk=2,
+                            prefill_bucket=8, kv_dtype="int8",
+                            kv_layout="paged", page_size=8, n_pages=10,
+                            prefix_cache=True, kv_tiering=True,
+                            dram_pages=32)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(0, cfg.vocab, 28)) for _ in range(3)]
+    eng.submit(prompts[0], max_new=8)
+    eng.run()                        # reap donates prompts[0]'s path
+    path0 = eng._prefix.match(prompts[0])
+    assert len(path0) >= 2, "scenario must donate a multi-page path"
+    idx0 = np.asarray(path0, np.int32)
+    # graftcheck: ignore[host-sync] — audit-harness capture of the donated bytes, before any demotion
+    before = jax.device_get([eng._k[:, idx0], eng._v[:, idx0],
+                             eng._ks[:, idx0], eng._vs[:, idx0]])
+    for p in prompts[1:]:            # pool pressure → LRU demotion
+        eng.submit(p, max_new=8)
+        eng.run()
+    assert eng.pool_metrics()["page_demotions_total"] > 0, \
+        "scenario must actually demote"
+    # Re-admission through the tier: promote + mount, then mid-decode.
+    eng.submit(prompts[0], max_new=9)
+    eng.step()
+    assert eng.pool_metrics()["page_promotions_total"] > 0, \
+        "scenario must serve through a promotion"
+    path1 = eng._prefix.match(prompts[0])
+    assert len(path1) == len(path0), "the full path must survive the tier"
+    idx1 = np.asarray(path1, np.int32)
+    # graftcheck: ignore[host-sync] — audit-harness byte-compare of the promoted pages against the donated originals
+    after = jax.device_get([eng._k[:, idx1], eng._v[:, idx1],
+                            eng._ks[:, idx1], eng._vs[:, idx1]])
+    for b, a in zip(before, after):
+        assert np.array_equal(np.asarray(b), np.asarray(a)), \
+            "promoted pages must be byte-identical to the donated bytes"
+    shared = sorted({p for pages in eng._slot_shared.values()
+                     for p in pages})
+    assert set(path1) <= set(shared), "the promoted path must be mounted"
+    args = (eng.params, eng._k, eng._v, eng._ks, eng._vs,
+            eng._table_np.copy(), eng._lens, eng._last,
+            np.asarray([s in eng._slot_req for s in range(eng.n_slots)]),
+            np.int32(99))
+    # _decode returns (k, v, k_s, v_s, table, lens, last, toks).
+    return eng._decode, args, (1, 2, 3, 4), (0, 1, 2, 3), shared
+
+
 def _alias_decode_scenario() -> tuple:
     """A decode chunk over a block table whose prefix rows are shared:
     the per-slot scatter at ``lens`` must land past the mounted prefix,
@@ -1149,5 +1299,6 @@ def alias_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
         ("batcher_prefill_paged_prefix", _alias_prefill_scenario),
         ("batcher_prefill_prefix_kernel", _alias_prefill_kernel_scenario),
         ("batcher_decode_paged_prefix", _alias_decode_scenario),
+        ("batcher_decode_paged_promoted", _alias_promoted_scenario),
         ("batcher_verify_paged_prefix", _alias_verify_scenario),
     ]

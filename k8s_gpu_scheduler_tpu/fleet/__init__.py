@@ -12,7 +12,7 @@ from .journal import (
 from .router import FleetError, Router
 from .summary import (
     MemoryStore, ReplicaSummary, list_summaries, prefix_match_len,
-    publish_summary, summarize,
+    prefix_match_parts, publish_summary, summarize,
 )
 
 __all__ = [
@@ -37,6 +37,7 @@ __all__ = [
     "SUSPECT",
     "list_summaries",
     "prefix_match_len",
+    "prefix_match_parts",
     "publish_summary",
     "summarize",
 ]

@@ -8,7 +8,8 @@ by SGLang-style cache-aware load balancing: every replica publishes a
 :class:`~.summary.ReplicaSummary` (radix digest + pool watermarks +
 per-phase p50s) into the registry, and admission scores
 
-    score(replica) = (1 + prefix_match_len(prompt, digest))
+    score(replica) = (1 + resident_match
+                          + DEMOTED_MATCH_DISCOUNT × demoted_match)
                      × (eps + free_page_frac)
                      × (eps + free_slot_frac)
                      × 1 / (1 + decode_p50 / p50_ref)
@@ -17,7 +18,10 @@ per-phase p50s) into the registry, and admission scores
 taking the argmax with a deterministic tiebreak (lowest replica id —
 same summaries, same placement, always). The match term routes shared
 system prompts to the replica that already holds their KV (prefill cost
-scales with the novel suffix — PR 4); the load terms keep a cold cache
+scales with the novel suffix — PR 4); demoted-match tokens (KV pages in
+the host-DRAM tier, PR 16) count at ``DEMOTED_MATCH_DISCOUNT`` — the
+pages skip prefill compute but pay a promotion upload, so they score
+below resident, above a cold miss; the load terms keep a cold cache
 from losing every request to a hot one; the latency term is the
 DistServe observation that decode-phase pressure (TPOT) is the thing
 co-placement hurts, so it is scored per-phase rather than folded into a
@@ -94,13 +98,21 @@ from .health import (
 )
 from .journal import DONE, ERROR, EXPIRED, JournalError, RequestJournal
 from .summary import (
-    MemoryStore, ReplicaSummary, list_summaries, prefix_match_len,
+    MemoryStore, ReplicaSummary, list_summaries, prefix_match_parts,
     publish_summary, summarize,
 )
 
 # Phases feeding the routing p50s (the names _obs_span records).
 _DECODE_PHASES = ("decode_chunk", "verify")
 _PREFILL_PHASES = ("prefill", "prefill_chunk")
+
+# A demoted-path match is worth this fraction of a resident one: the
+# pages exist (no prefill compute) but pay a DRAM→HBM promotion upload
+# at admission. Strictly in (0, 1), so for the same digest path a
+# resident replica always outscores a demoted one, and a demoted one
+# always outscores a cold miss — the satellite ordering the KV-tiering
+# issue pins.
+DEMOTED_MATCH_DISCOUNT = 0.5
 
 
 class FleetError(RuntimeError):
@@ -385,14 +397,16 @@ class Router:
         """(score, prefix match tokens) for placing ``prompt`` on the
         summarized replica — a pure function of its arguments, which is
         what makes placement deterministic and testable."""
-        match = prefix_match_len(prompt, summary.digest, self.page_size)
+        match, resident = prefix_match_parts(
+            prompt, summary.digest, self.page_size)
+        effective = resident + DEMOTED_MATCH_DISCOUNT * (match - resident)
         eps = self.load_eps
         load = ((eps + summary.free_frac)
                 * (eps + summary.free_slot_frac)
                 / (1.0 + summary.decode_p50_s / self.p50_ref_s)
                 / (1.0 + max(0, summary.prefill_backlog_tokens)
                    / self.backlog_ref_tokens))
-        return (1.0 + match) * load, match
+        return (1.0 + effective) * load, match
 
     def _routable_ids(self) -> List[str]:
         return [rid for rid in self._replicas

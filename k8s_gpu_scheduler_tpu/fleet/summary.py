@@ -21,7 +21,11 @@ AGAINST THE DIGEST — page-aligned and capped one page below full cover,
 mirroring ``PrefixCache.match``'s contract (admission always leaves the
 last page to prefill), so the score predicts exactly the prefill rows
 admission will actually skip. Truncated digest paths under-claim, never
-over-claim.
+over-claim. Tiered replicas (KV tiering, PR 16) publish a third
+per-path element — the RESIDENT token length — and
+``prefix_match_parts`` splits a match into free-hit resident tokens vs
+demoted tokens that pay a DRAM→HBM promotion upload, so the router can
+price the upload without losing the hit.
 
 ``MemoryStore`` is the in-process registry stand-in (the
 get/set/get_keys/mget subset of registry/client.py's ``Client``): a
@@ -79,19 +83,30 @@ class ReplicaSummary:
     # replicated-weight one at the same tp. Default 0 keeps
     # pre-weight-sharding summaries parsing.
     weight_device_bytes: int = 0
-    # [(token path, full cached token length)], hottest first.
+    # Host-DRAM tier occupancy (KV tiering, models/serving.py
+    # kv_tiering=): pages held off-pool that a match can promote back.
+    # Capacity signal only — the per-path upload cost lives in the
+    # digest tier flags below. Default 0 keeps pre-tiering summaries
+    # parsing.
+    dram_cached_pages: int = 0
+    # [(token path, full cached token length)], hottest first. Tiered
+    # replicas publish 3-tuples (token path, cached length, RESIDENT
+    # length): resident tokens hit for free, the demoted remainder
+    # (cached - resident) pays a DRAM→HBM upload at admission. 2-tuples
+    # (untiered replicas, pre-tiering summaries) mean fully resident.
     digest: List[Tuple[List[int], int]] = field(default_factory=list)
 
     def to_json(self) -> str:
         d = asdict(self)
-        d["digest"] = [[list(map(int, t)), int(n)] for t, n in self.digest]
+        d["digest"] = [[list(map(int, e[0]))] + [int(x) for x in e[1:]]
+                       for e in self.digest]
         return json.dumps(d, sort_keys=True)
 
     @staticmethod
     def from_json(raw: str) -> "ReplicaSummary":
         d = json.loads(raw)
-        digest = [(list(map(int, t)), int(n))
-                  for t, n in d.pop("digest", [])]
+        digest = [tuple([list(map(int, e[0]))] + [int(x) for x in e[1:]])
+                  for e in d.pop("digest", [])]
         return ReplicaSummary(digest=digest, **d)
 
     @property
@@ -125,32 +140,57 @@ def summarize(engine, replica: str, fleet: str = "fleet", seq: int = 0,
         prefill_backlog_tokens=int(st.get("prefill_backlog_tokens", 0)),
         tp=int(st.get("tp", 1)),
         weight_device_bytes=int(st.get("weight_device_bytes", 0)),
+        dram_cached_pages=int(st.get("dram_cached_pages", 0)),
         digest=engine.cache_digest(top_k, max_tokens),
     )
 
 
-def prefix_match_len(prompt: Sequence[int],
-                     digest: Sequence[Tuple[Sequence[int], int]],
-                     page_size: int) -> int:
-    """Cached-prefix tokens a replica with this digest would serve for
-    ``prompt``: the longest common token prefix against any digest path,
-    floored to page granularity and capped so at least the prompt's last
-    page prefills — the exact shape of ``PrefixCache.match``'s answer,
-    predicted from the digest alone."""
+def prefix_match_parts(prompt: Sequence[int],
+                       digest: Sequence[Tuple[Sequence[int], int]],
+                       page_size: int) -> Tuple[int, int]:
+    """``(match tokens, resident tokens)`` a replica with this digest
+    would serve for ``prompt``: the longest common token prefix against
+    any digest path, floored to page granularity and capped so at least
+    the prompt's last page prefills — the exact shape of
+    ``PrefixCache.match``'s answer, predicted from the digest alone.
+    ``resident`` ≤ ``match`` is the portion already in HBM; the
+    remainder is demoted to the DRAM tier and pays a promotion upload
+    at admission (2-tuple digest entries count as fully resident). Best
+    entry by total match, resident length breaking ties — two replicas
+    covering the same prefix differ only in upload cost."""
     if page_size < 1:
         raise ValueError(f"page_size must be >= 1, got {page_size}")
-    best = 0
-    for tokens, cached_len in digest:
+    best, best_res = 0, 0
+    for entry in digest:
+        tokens, cached_len = entry[0], int(entry[1])
+        res_len = int(entry[2]) if len(entry) > 2 else cached_len
         m = 0
         for a, b in zip(prompt, tokens):
             if int(a) != int(b):
                 break
             m += 1
-        best = max(best, min(m, int(cached_len)))
-    pages = best // page_size
-    if pages and pages * page_size == len(prompt):
+        cand = min(m, cached_len)
+        cand_res = min(cand, res_len)
+        if cand > best or (cand == best and cand_res > best_res):
+            best, best_res = cand, cand_res
+    match = _page_floor(best, len(prompt), page_size)
+    resident = min(match, _page_floor(best_res, len(prompt), page_size))
+    return match, resident
+
+
+def _page_floor(tokens: int, prompt_len: int, page_size: int) -> int:
+    pages = tokens // page_size
+    if pages and pages * page_size == prompt_len:
         pages -= 1                   # the last page always re-prefills
     return pages * page_size
+
+
+def prefix_match_len(prompt: Sequence[int],
+                     digest: Sequence[Tuple[Sequence[int], int]],
+                     page_size: int) -> int:
+    """Total cached-prefix tokens (resident + demoted) — see
+    ``prefix_match_parts`` for the tier split."""
+    return prefix_match_parts(prompt, digest, page_size)[0]
 
 
 def publish_summary(client, summary: ReplicaSummary) -> None:

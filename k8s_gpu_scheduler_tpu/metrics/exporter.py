@@ -273,6 +273,23 @@ SERVING_POOL_GAUGES = {
     "weight_sliced_device_bytes":
         "per-chip bytes of the Megatron-sliced weight leaves "
         "(exactly 1/tp of their unsharded total)",
+    # KV tiering (serving kv_tiering=): host-DRAM second tier + optional
+    # disk third tier behind the radix tree (models/paging.py
+    # HostTierStore). These keys exist only on tiered engines, so the
+    # exposition of every untiered caller stays byte-identical.
+    "tier_dram_pages": "KV pages demoted to the host-DRAM tier",
+    "tier_dram_capacity": "host-DRAM tier capacity (pages)",
+    "tier_disk_pages": "KV pages spilled to the disk tier",
+    "tier_pending_demotions":
+        "pages reserved for demotion, awaiting step-boundary readback",
+    "page_demotions_total": "cumulative KV pages demoted HBM -> host DRAM",
+    "page_promotions_total": "cumulative KV pages promoted host DRAM -> HBM",
+    "prefix_demoted_pages": "radix-tree nodes whose page is demoted off-pool",
+    "tier_spills_total": "cumulative DRAM-tier pages spilled to the disk tier",
+    "tier_forgotten_total":
+        "cumulative demoted pages forgotten at DRAM capacity (no disk tier)",
+    "tier_cancelled_demotions":
+        "pending demotions cancelled by a mid-match retain (pins win)",
     "spec_accept_rate": "speculative proposals accepted / proposed",
     "spec_tokens_per_dispatch":
         "tokens committed per active slot per verify dispatch",
@@ -316,6 +333,14 @@ PREFIX_HIT_HISTOGRAM = "tpu_serve_prefix_hit_tokens"
 PREFIX_HIT_BUCKETS = (8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
                       1024.0, 2048.0, 4096.0, 8192.0)
 
+# Promoted-hit lengths (tokens served through a DRAM->HBM promotion
+# upload per admission), fed from ``promoted_hit_token_batch`` — drained
+# in the SAME _obs_mu snapshot as the prefix-hit batch (the torn-read
+# rule), present only on tiered engines. Same token buckets: the ratio
+# promoted_sum / prefix_hit_sum is the fraction of cache hits the DRAM
+# tier rescued from eviction.
+PROMOTED_HIT_HISTOGRAM = "tpu_serve_promoted_hit_tokens"
+
 # Info-style metric for the island weight-combine mode (pool_metrics()
 # "tp_combine": "all_gather" | "psum" | "replicated" | "none"): value 1
 # under {kind=} — the PromQL-friendly encoding of an enum that never
@@ -355,7 +380,8 @@ def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
         hist = registry.histogram(
             PHASE_HISTOGRAM,
             "Request-lifecycle phase durations (queue|admit|prefill|"
-            "prefill_chunk|decode_chunk|verify|rewind|reap), by phase",
+            "prefill_chunk|decode_chunk|verify|rewind|reap, plus "
+            "demote|promote on tiered engines), by phase",
             buckets=PHASE_BUCKETS)
         for phase, seconds in phases:
             hist.observe(float(seconds), phase=str(phase), **labels)
@@ -367,6 +393,15 @@ def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
             "(0 = miss; whole mounted conversations land in the tail)",
             buckets=PREFIX_HIT_BUCKETS)
         for tokens in hits:
+            hist.observe(float(tokens), **labels)
+    promoted = pool_metrics.get("promoted_hit_token_batch") or ()
+    if promoted:
+        hist = registry.histogram(
+            PROMOTED_HIT_HISTOGRAM,
+            "Prefix-hit tokens served through a DRAM->HBM promotion "
+            "upload, per admission (KV tiering)",
+            buckets=PREFIX_HIT_BUCKETS)
+        for tokens in promoted:
             hist.observe(float(tokens), **labels)
     combine = pool_metrics.get("tp_combine")
     if combine:

@@ -84,7 +84,7 @@ from ..ops.layers import apply_rope, rms_norm, rope_freqs
 from ..ops.quant import qdot
 from ..testing.faults import Preempted
 from .llama import LlamaConfig, _constrain, mlp_sublayer
-from .paging import NULL_PAGE, PageAllocator
+from .paging import NULL_PAGE, HostTierStore, PageAllocator
 from .prefix_cache import PrefixCache
 from .snapshot import ServingSnapshot, SnapshotError, check_fingerprint
 
@@ -1121,6 +1121,23 @@ def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
     return k, v, k_s, v_s, table, lens, last, emitted, accepts
 
 
+def scatter_pool_pages(k, v, ks, vs, idx, kp, vp, ksp, vsp):
+    """Pure page-relocation primitive: land host page payloads
+    (``kp``/``vp`` [L, len(idx), ps, Hkv, hd], + int8 scale planes when
+    the pool carries them) into pool pages ``idx`` — ONE scatter per
+    plane, shared by the snapshot restore/absorb LUT move and the KV
+    tier's promotion upload. graftcheck's traffic registry traces
+    exactly this function (``traffic_promote_upload``): the payload is
+    O(moved pages), the only pool-scale values are the update chain
+    itself. ``ks``/``vs`` are None on f32 pools."""
+    k = k.at[:, idx].set(jnp.asarray(kp, k.dtype))
+    v = v.at[:, idx].set(jnp.asarray(vp, v.dtype))
+    if ks is not None:
+        ks = ks.at[:, idx].set(jnp.asarray(ksp, jnp.float32))
+        vs = vs.at[:, idx].set(jnp.asarray(vsp, jnp.float32))
+    return k, v, ks, vs
+
+
 def _prefill_multi_paged_fn(params, cfg: LlamaConfig, page_size: int,
                             k, v, lens, last, slots, page_ids,
                             prefix_tables, hit_lens, tokens, tail_lens,
@@ -1447,6 +1464,9 @@ class ContinuousBatcher:
                  page_size: int = DEFAULT_PAGE_SIZE,
                  n_pages: Optional[int] = None,
                  prefix_cache: bool = False,
+                 kv_tiering: bool = False,
+                 dram_pages: Optional[int] = None,
+                 kv_tier_disk: Optional[str] = None,
                  prefill_chunk_tokens: Optional[int] = None,
                  speculative: bool = False, gamma: int = 4,
                  prefill_attn: Optional[str] = None,
@@ -1461,7 +1481,8 @@ class ContinuousBatcher:
         # tests pass a VirtualClock); ``tracer`` (obs.Tracer, None in
         # production — one `is None` check per phase) collects the
         # request-lifecycle spans queue|admit|prefill|decode_chunk|
-        # verify|rewind|reap; the flight recorder (always on — one host
+        # verify|rewind|reap (plus demote|promote on tiered engines);
+        # the flight recorder (always on — one host
         # dict append per step, capacity 0 disables) keeps the per-step
         # ring that drain() folds into the snapshot. ``_obs_mu`` guards
         # the cross-thread observability state so pool_metrics() exports
@@ -1485,6 +1506,12 @@ class ContinuousBatcher:
         # 8-token system-prompt hits are different fleets). Bounded
         # drop-oldest like every obs buffer.
         self._hit_tok_buf: deque = deque(maxlen=4096)
+        # The PROMOTED subset of those hit lengths (tokens whose pages
+        # were re-uploaded from the host tier at admission) — drained in
+        # the same pool_metrics() lock snapshot into the
+        # tpu_serve_promoted_hit_tokens histogram: how much of the hit
+        # mass actually paid an upload.
+        self._promoted_hit_buf: deque = deque(maxlen=4096)
         self._timelines: "OrderedDict[int, list]" = OrderedDict()
         self._rid_label: Dict[int, str] = {}
         self._step_faults: list = []
@@ -1662,6 +1689,24 @@ class ContinuousBatcher:
                          f"not scale with tp; see tpu_serve_decode_"
                          f"fallback_total{{reason='weights_replicated'}}"
                          ))
+        # KV tiering (host-DRAM second tier + optional disk third tier
+        # behind the radix tree): validated HERE, built below once the
+        # pool geometry is known. Pure capacity/scheduling knobs —
+        # deliberately absent from fingerprint(), like n_pages.
+        if kv_tiering and kv_layout != "paged":
+            raise ValueError(
+                "kv_tiering=True requires kv_layout='paged' (the tier "
+                "demotes page-pool pages behind the radix tree)")
+        if kv_tiering and not prefix_cache:
+            raise ValueError(
+                "kv_tiering=True requires prefix_cache=True (demotion "
+                "parks CACHED tree pages; without the tree there is "
+                "nothing to tier)")
+        if not kv_tiering and (dram_pages is not None
+                               or kv_tier_disk is not None):
+            raise ValueError(
+                "dram_pages/kv_tier_disk require kv_tiering=True")
+        self._tier: Optional[HostTierStore] = None
         if kv_layout == "paged":
             if self.S % page_size:
                 raise ValueError(
@@ -1757,7 +1802,21 @@ class ContinuousBatcher:
             # donate their full-page KV into a token-chunk tree; admission
             # mounts the longest cached page-aligned prefix read-only and
             # prefills only the novel tail.
-            self._prefix = (PrefixCache(self._alloc, page_size)
+            # KV tiering: LRU eviction DEMOTES cached leaves into a
+            # host-DRAM store (default capacity = the pool itself)
+            # instead of forgetting them; a later match through a
+            # demoted path re-uploads the pages ahead of the slot's
+            # first prefill (_admit_paged). ``kv_tier_disk`` arms the
+            # disk third tier: DRAM-capacity sheds spill there instead
+            # of forgetting (demote-before-forget, disk only when DRAM
+            # is full).
+            if kv_tiering:
+                self._tier = HostTierStore(
+                    int(dram_pages) if dram_pages is not None
+                    else int(n_pages),
+                    disk_dir=kv_tier_disk)
+            self._prefix = (PrefixCache(self._alloc, page_size,
+                                        tier=self._tier)
                             if prefix_cache else None)
             self._skipped_tokens = 0                 # prefill rows reused
             # Chunked prefill: the per-STEP prompt-token budget the
@@ -2418,6 +2477,47 @@ class ContinuousBatcher:
         self._table_np[slot] = NULL_PAGE
         self._table_dirty = True
 
+    def _drain_demotions(self) -> None:
+        """Drain the pending device→host demotion queue at a STEP
+        BOUNDARY: ONE batched gather of the enqueued pages' bytes (+
+        int8 scale planes), committed into the host tier per page;
+        each pool page then returns to the free list (``drop_cached``).
+        This never runs inside a dispatch — the pool is donated every
+        step, so the copy is scheduled from the host exactly like
+        ``drain()``'s sanctioned gathers (a pending page stays
+        allocated+cached meanwhile, so no dispatch can overwrite it).
+        A commit the tier refuses (DRAM full, nothing evictable)
+        forgets the node instead: demote-before-forget degrades to the
+        plain eviction outcome, it never blocks admission."""
+        if self._tier is None:
+            return
+        pend = self._tier.take_pending()
+        if not pend:
+            return
+        t0 = self._clock.monotonic()
+        idx = np.asarray([p for _, p in pend], np.int32)
+        # graftcheck: ignore[host-sync] — sanctioned: the demotion drain IS a readback (one batched O(demoted pages) gather per step boundary, the tier's whole design)
+        gathered = jax.device_get(
+            # graftcheck: ignore[use-after-donate] — sanctioned: runs at a step boundary (no dispatch in flight), so the pool is the COMMITTED post-dispatch array; pending pages stay allocated+cached until drop_cached below
+            [self._k[:, idx], self._v[:, idx]]
+            # graftcheck: ignore[use-after-donate] — sanctioned: same step-boundary contract (scale planes)
+            + ([self._ks[:, idx], self._vs[:, idx]]
+               if self._ks is not None else []))
+        k, v = gathered[0], gathered[1]
+        ks = vs = None
+        if self._ks is not None:
+            ks, vs = gathered[2], gathered[3]
+        for i, (key, page) in enumerate(pend):
+            payload = (np.asarray(k[:, i]), np.asarray(v[:, i]),
+                       None if ks is None else np.asarray(ks[:, i]),
+                       None if vs is None else np.asarray(vs[:, i]))
+            if not self._tier.commit(key, payload):
+                self._prefix.drop_demoted(key)
+            self._alloc.drop_cached(page)
+        if self._tracer is not None:
+            self._obs_span("demote", t0, self._clock.monotonic(),
+                           pages=len(pend))
+
     def _admit_paged(self) -> list:
         """Paged admission: take free PAGES wherever they are (no
         contiguous window, no backward-write trick), so the only gates
@@ -2438,6 +2538,7 @@ class ContinuousBatcher:
             t_adm = self._clock.monotonic()
             evicted = 0
             hits: list = []
+            demoted: list = []
             if self._prefix is not None:
                 # Longest cached page-aligned prefix (always leaves >= 1
                 # token to prefill — the admission needs last-position
@@ -2446,18 +2547,55 @@ class ContinuousBatcher:
                 # LRU sweep can never reclaim pages we are mounting.
                 # Retries of a page-blocked head re-match every step but
                 # count once, like the allocator's denial metric.
-                hits = self._prefix.match(
-                    prompt, count=req_id != self._last_denied)
+                if self._tier is not None:
+                    # Tiered match: the path extends THROUGH demoted
+                    # nodes — the resident prefix mounts as usual, the
+                    # demoted suffix is re-uploaded into fresh pool
+                    # pages below, before the first prefill dispatch.
+                    # (A pending demotion the walk crosses is cancelled
+                    # in place — the retain pin wins the race for free.)
+                    path, demoted = self._prefix.match_tiered(
+                        prompt, count=req_id != self._last_denied)
+                    hits = path[:len(path) - len(demoted)]
+                else:
+                    hits = self._prefix.match(
+                        prompt, count=req_id != self._last_denied)
                 if hits:
                     self._alloc.retain(hits)
-            need = self._pages_needed(P, self._budget[req_id]) - len(hits)
-            if self._prefix is not None and need > self._alloc.free_count:
+            # Fresh pages: the slot's own reservation PLUS one per
+            # demoted hit page to promote into.
+            need = (self._pages_needed(P, self._budget[req_id])
+                    - len(hits) - len(demoted))
+            if self._prefix is not None \
+                    and need + len(demoted) > self._alloc.free_count:
                 # Tree-only pages are reclaimable capacity, not occupancy:
                 # evict the coldest unshared leaves to make room.
-                evicted = need - self._alloc.free_count
+                evicted = need + len(demoted) - self._alloc.free_count
                 self._prefix.evict(evicted)
+                if self._tier is not None:
+                    # With a tier, evict() only ENQUEUES demotions — the
+                    # pages return to the free list when the readback
+                    # queue drains, which must happen before the alloc
+                    # below can see them.
+                    self._drain_demotions()
+                    # The tier-capacity shed inside that drain may have
+                    # forgotten cold committed entries — possibly the
+                    # tail of THIS request's own demoted path. Keep the
+                    # still-promotable prefix.
+                    alive = 0
+                    for nd in demoted:
+                        if nd.demoted is None \
+                                or not self._tier.has(nd.demoted):
+                            break
+                        alive += 1
+                    if alive < len(demoted):
+                        del demoted[alive:]
+                        need = (self._pages_needed(
+                            P, self._budget[req_id])
+                            - len(hits) - len(demoted))
             pages = self._alloc.alloc(
-                need, count_denied=req_id != self._last_denied)
+                need + len(demoted),
+                count_denied=req_id != self._last_denied)
             if pages is None:
                 # No pages for the head — STOP admitting (strict FCFS, the
                 # same starvation argument as the contiguous path: letting
@@ -2483,6 +2621,35 @@ class ContinuousBatcher:
                 self._last_denied = None
             self._queue.pop(0)
             slot = free.pop()
+            if demoted:
+                # Promotion: upload the demoted suffix's parked bytes
+                # into the first len(demoted) fresh pages BEFORE the
+                # slot's first prefill dispatch — the promoted pages
+                # then mount exactly like resident hits (read-only,
+                # shared, retained per mounting slot). The tree adopts
+                # the allocation's reference (promote() mirrors
+                # donation), so the slot's own mount retains on top.
+                t_pr = self._clock.monotonic()
+                promo, pages = pages[:len(demoted)], pages[len(demoted):]
+                pay = [self._tier.pop(nd.demoted) for nd in demoted]
+                self._scatter_pages(
+                    promo,
+                    np.stack([p[0] for p in pay], axis=1),
+                    np.stack([p[1] for p in pay], axis=1),
+                    (np.stack([p[2] for p in pay], axis=1)
+                     if self._ks is not None else None),
+                    (np.stack([p[3] for p in pay], axis=1)
+                     if self._ks is not None else None))
+                self._prefix.promote(demoted, promo)
+                self._alloc.retain(promo)
+                hits = hits + promo
+                with self._obs_mu:
+                    self._promoted_hit_buf.append(
+                        len(promo) * self.page_size)
+                if self._tracer is not None:
+                    self._obs_span("promote", t_pr,
+                                   self._clock.monotonic(), rid=req_id,
+                                   pages=len(promo))
             row = self._table_np[slot]
             row[:] = NULL_PAGE
             row[:len(hits)] = hits                   # shared, read-only
@@ -3139,7 +3306,12 @@ class ContinuousBatcher:
         implementation is pinned token-identical to the gather by the
         parity suites (and follows ``decode_attn`` — which IS recorded —
         in auto mode), and decoded-suffix donation only changes what the
-        local radix tree caches, never how restored pages decode. Model
+        local radix tree caches, never how restored pages decode.
+        ``kv_tiering``/``dram_pages``/``kv_tier_disk`` are excluded for
+        the same n_pages reason: the tier is pure reclaimable CAPACITY —
+        a tiered drain restores onto an untiered engine (the tier
+        sidecar drops, demoted tree paths truncate) and vice versa, with
+        every live stream and resident page intact. Model
         WEIGHTS are the
         caller's obligation: restore into an engine holding different
         params resumes streams that decode differently, and no
@@ -3216,6 +3388,10 @@ class ContinuousBatcher:
                     f"slots carry migratable requests")
         t0 = self._clock.monotonic()
         self._flush()
+        # Pending demotions resolve first (this IS a step boundary):
+        # dump_paths below serializes demoted chunks by tier key, so
+        # every key must be COMMITTED before the tree is walked.
+        self._drain_demotions()
         if not partial and self._chaos_pages:  # chaos hostages are not state
             self._alloc.free(self._chaos_pages)
             self._chaos_pages = []
@@ -3225,7 +3401,10 @@ class ContinuousBatcher:
         def add(pages):
             for p in pages:
                 p = int(p)
-                if p != NULL_PAGE and p not in seen:
+                # Negative entries are demoted chunks (-(tier key + 1),
+                # dump_paths' wire form) — their bytes ride the tier
+                # sidecar, not the page payload.
+                if p > 0 and p not in seen:
                     seen.add(p)
                     ids.append(p)
 
@@ -3237,6 +3416,25 @@ class ContinuousBatcher:
                       if self._prefix is not None and not partial else [])
         for _, pages in tree_paths:
             add(pages)
+        # The DRAM tier rides the snapshot host-numpy-native (it IS
+        # host numpy), coldest first — disk spills coldest of all — so
+        # a restore into a smaller dram_pages budget keeps the hottest
+        # tail. Partial drains never ship it (no tree either).
+        tier_keys: list = []
+        tier_entries: list = []
+        if self._tier is not None and not partial:
+            for key, payload in self._tier.items_coldest_first():
+                tier_keys.append(int(key))
+                tier_entries.append(payload)
+        if tier_entries:
+            tier_k = np.stack([p[0] for p in tier_entries], axis=1)
+            tier_v = np.stack([p[1] for p in tier_entries], axis=1)
+            tier_ks = (np.stack([p[2] for p in tier_entries], axis=1)
+                       if tier_entries[0][2] is not None else None)
+            tier_vs = (np.stack([p[3] for p in tier_entries], axis=1)
+                       if tier_entries[0][3] is not None else None)
+        else:
+            tier_k = tier_v = tier_ks = tier_vs = None
 
         if ids:
             idx = np.asarray(ids, np.int32)
@@ -3327,6 +3525,11 @@ class ContinuousBatcher:
                          for r, n in self._eos_scanned.items()
                          if keep_rid(r)},
             tree_paths=tree_paths,
+            tier_keys=tier_keys,
+            tier_k=tier_k,
+            tier_v=tier_v,
+            tier_ks=tier_ks,
+            tier_vs=tier_vs,
             arrival={r: t for r, t in self._arrival.items()
                      if keep_rid(r)},
             first_tok={r: t for r, t in self._first_tok.items()
@@ -3435,8 +3638,40 @@ class ContinuousBatcher:
         if snap.tree_paths and self._prefix is None:
             raise SnapshotError(
                 "snapshot carries a prefix tree but prefix_cache=False")
+        # Tiered snapshot: re-admit the shipped DRAM payloads under
+        # fresh keys. Entries ship coldest first, so only the hottest
+        # tail that fits this engine's dram_pages budget is kept; an
+        # UNTIERED target drops them all — the tree paths below
+        # truncate at the first unmapped demoted chunk, which is also
+        # how pre-tiering engines load tiered snapshots unchanged.
+        keymap: Dict[int, int] = {}
+        if snap.tier_keys and self._tier is not None:
+            lo = max(0, len(snap.tier_keys) - self._tier.dram_pages)
+            for i in range(lo, len(snap.tier_keys)):
+                payload = (
+                    np.asarray(snap.tier_k[:, i]),
+                    np.asarray(snap.tier_v[:, i]),
+                    (np.asarray(snap.tier_ks[:, i])
+                     if snap.tier_ks is not None else None),
+                    (np.asarray(snap.tier_vs[:, i])
+                     if snap.tier_vs is not None else None))
+                nk = self._tier.restore_entry(payload)
+                if nk is not None:
+                    keymap[int(snap.tier_keys[i])] = nk
         for tokens, pages in snap.tree_paths:
-            self._prefix.insert(tokens, remap(pages))
+            mapped: list = []
+            for p in pages:
+                p = int(p)
+                if p >= 0:
+                    mapped.append(int(lut[p]))
+                    continue
+                nk = keymap.get(-p - 1)
+                if nk is None:           # dropped tier entry: truncate
+                    break
+                mapped.append(-(nk + 1))
+            if mapped:
+                self._prefix.insert(
+                    list(tokens)[:len(mapped) * self.page_size], mapped)
         self._slot_req = dict(snap.slot_req)
         self._slot_pages = {s: remap(pg)
                             for s, pg in snap.slot_pages.items()}
@@ -3494,6 +3729,9 @@ class ContinuousBatcher:
         need = len(snap.page_ids)
         if self._prefix is not None and need > self._alloc.free_count:
             self._prefix.evict(need - self._alloc.free_count)
+            # With a tier, evict() enqueues demotions; the pages free
+            # only once the readback drains (no-op untiered).
+            self._drain_demotions()
         new = self._alloc.alloc(need)
         if new is None:
             raise SnapshotError(
@@ -3504,27 +3742,30 @@ class ContinuousBatcher:
         for old, nw in zip(snap.page_ids, new):
             lut[old] = nw
         if new:
-            idx = np.asarray(new, np.int32)
-            self._k = self._k.at[:, idx].set(
-                jnp.asarray(snap.k_pages, self._k.dtype))
-            self._v = self._v.at[:, idx].set(
-                jnp.asarray(snap.v_pages, self._v.dtype))
-            if self._ks is not None:
-                if snap.k_scales is None:
-                    raise SnapshotError(
-                        "int8-KV engine but snapshot has no scale planes")
-                self._ks = self._ks.at[:, idx].set(
-                    jnp.asarray(snap.k_scales, jnp.float32))
-                self._vs = self._vs.at[:, idx].set(
-                    jnp.asarray(snap.v_scales, jnp.float32))
-            if self._mesh is not None:
-                # Snapshot portability across mesh shapes: the shipped
-                # pages are a host pytree, mesh-agnostic by construction
-                # (drain gathers the FULL kv-head dim); landing them here
-                # re-shards onto THIS engine's tp — tp=2 → tp=1 → tp=4
-                # round trips are pure data movement.
-                self._reshard_pool()
+            self._scatter_pages(new, snap.k_pages, snap.v_pages,
+                                snap.k_scales, snap.v_scales)
         return lut
+
+    def _scatter_pages(self, pages, k, v, ks=None, vs=None) -> None:
+        """Land host page bytes (+ int8 scale planes) into pool
+        ``pages`` — ONE eager scatter per plane, shared by the
+        snapshot restore/absorb LUT move and the tier promotion upload
+        (the old→new relocation over pool bytes IS the migration
+        primitive; there is exactly one copy path). Arrays are
+        [L, len(pages), ps, Hkv, hd] host values; runs only between
+        dispatches (admission / restore time), and re-shards onto the
+        island mesh when one is attached — the shipped bytes carry the
+        FULL kv-head dim, so tp=2 → tp=1 → tp=4 round trips are pure
+        data movement."""
+        if self._ks is not None and ks is None:
+            raise SnapshotError(
+                "int8-KV engine but the shipped pages carry no "
+                "scale planes")
+        idx = np.asarray(pages, np.int32)
+        self._k, self._v, self._ks, self._vs = scatter_pool_pages(
+            self._k, self._v, self._ks, self._vs, idx, k, v, ks, vs)
+        if self._mesh is not None:
+            self._reshard_pool()
 
     def absorb(self, snap: ServingSnapshot) -> Dict[int, int]:
         """Merge a PARTIAL snapshot — ``drain(slots=...)`` on a hot peer
@@ -3666,6 +3907,12 @@ class ContinuousBatcher:
             # actually fits big weights per chip — from a replicated-
             # weight one at the same tp.
             "weight_device_bytes": int(self._weight_dev_bytes),
+            # KV tiering: committed host-tier pages (DRAM + disk) — the
+            # upload-capacity context behind the digest's demoted-path
+            # tier flags (absent/0 on untiered replicas, PR 9's
+            # default-tolerant summary convention).
+            "dram_cached_pages": (len(self._tier)
+                                  if self._tier is not None else 0),
         }
 
     def cache_digest(self, top_k: int = 8,
@@ -3794,6 +4041,16 @@ class ContinuousBatcher:
             if self._hit_tok_buf:
                 out["prefix_hit_token_batch"] = tuple(self._hit_tok_buf)
                 self._hit_tok_buf.clear()
+            # The PROMOTED subset of those hit lengths (pages that paid
+            # a tier upload) — same drained-exactly-once lock-snapshot
+            # contract; export_serving_pool folds them into the
+            # tpu_serve_promoted_hit_tokens histogram. Only tiered
+            # engines ever populate the buffer, so untiered exposition
+            # is byte-identical.
+            if self._promoted_hit_buf:
+                out["promoted_hit_token_batch"] = \
+                    tuple(self._promoted_hit_buf)
+                self._promoted_hit_buf.clear()
         return out
 
     def _flush(self) -> None:

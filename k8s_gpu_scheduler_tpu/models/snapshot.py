@@ -116,6 +116,19 @@ class ServingSnapshot:
     # ``restore()`` rejects it — a partial snapshot is not a whole
     # engine. Default False keeps older snapshots loading.
     partial: bool = False
+    # KV tiering (serving ``kv_tiering=True``): the host-DRAM tier's
+    # committed page payloads, COLDEST FIRST (disk spills coldest of
+    # all), so a restore into a smaller ``dram_pages`` budget keeps the
+    # hottest tail. ``tree_paths`` reference a demoted chunk as
+    # ``-(key + 1)`` — restore remaps the keys and truncates any path
+    # whose entry was dropped. All default-empty: pre-tiering snapshots
+    # load unchanged, untiered engines never populate them, and an
+    # untiered RESTORE target simply drops the payloads.
+    tier_keys: List[int] = field(default_factory=list)
+    tier_k: Optional[np.ndarray] = None    # [L, R2, ps, Hkv, hd]
+    tier_v: Optional[np.ndarray] = None
+    tier_ks: Optional[np.ndarray] = None   # [L, R2, ps, Hkv, 1] (int8)
+    tier_vs: Optional[np.ndarray] = None
 
     # -- derived -----------------------------------------------------------
     @property
@@ -130,6 +143,10 @@ class ServingSnapshot:
         n = self.k_pages.nbytes + self.v_pages.nbytes
         if self.k_scales is not None:
             n += self.k_scales.nbytes + self.v_scales.nbytes
+        for arr in (self.tier_k, self.tier_v, self.tier_ks,
+                    self.tier_vs):
+            if arr is not None:
+                n += arr.nbytes
         n += self.table.nbytes + self.lens.nbytes + self.last.nbytes
         n += len(json.dumps(self._meta_doc()).encode())
         return n
@@ -152,12 +169,38 @@ class ServingSnapshot:
             referenced.update(pages)
         for slot, pages in self.slot_shared.items():
             referenced.update(pages)
+        demoted_ref: set = set()
         for _, pages in self.tree_paths:
-            referenced.update(pages)
+            for p in pages:
+                p = int(p)
+                if p < 0:          # demoted chunk: -(tier key + 1)
+                    demoted_ref.add(-p - 1)
+                else:
+                    referenced.add(p)
         missing = referenced - have
         if missing:
             raise SnapshotError(
                 f"referenced pages missing payloads: {sorted(missing)}")
+        tkeys = [int(k) for k in self.tier_keys]
+        if len(tkeys) != len(set(tkeys)):
+            raise SnapshotError(f"duplicate tier keys: {tkeys}")
+        if self.partial and tkeys:
+            raise SnapshotError(
+                "partial snapshot must not carry a DRAM tier")
+        missing_tier = demoted_ref - set(tkeys)
+        if missing_tier:
+            raise SnapshotError(
+                f"tree paths reference demoted pages whose tier "
+                f"payloads did not ship: keys {sorted(missing_tier)}")
+        if tkeys:
+            if self.tier_k is None or self.tier_v is None:
+                raise SnapshotError(
+                    f"{len(tkeys)} tier keys but no tier payload")
+            if self.tier_k.shape[1] != len(tkeys) or \
+                    self.tier_v.shape[1] != len(tkeys):
+                raise SnapshotError(
+                    f"tier payload rows {self.tier_k.shape[1]} != "
+                    f"{len(tkeys)} tier keys")
         for rid in self.slot_req.values():
             if rid not in self.budgets:
                 raise SnapshotError(f"in-flight request {rid} has no budget")
@@ -203,6 +246,10 @@ class ServingSnapshot:
             "payload_shape": [int(x) for x in self.k_pages.shape],
             "payload_dtype": str(np.asarray(self.k_pages).dtype),
             "has_scales": self.k_scales is not None,
+            # DRAM-tier sidecar (absent-tolerant on load, PR 9
+            # convention): the payload arrays ride the pytree like the
+            # page payload; empty tiers ship nothing.
+            "tier_keys": [int(k) for k in self.tier_keys],
         }
 
     def to_pytree(self) -> Dict[str, np.ndarray]:
@@ -225,6 +272,12 @@ class ServingSnapshot:
         if self.k_scales is not None and np.asarray(self.k_scales).size:
             tree["k_scales"] = np.asarray(self.k_scales)
             tree["v_scales"] = np.asarray(self.v_scales)
+        if self.tier_k is not None and np.asarray(self.tier_k).size:
+            tree["tier_k"] = np.asarray(self.tier_k)
+            tree["tier_v"] = np.asarray(self.tier_v)
+        if self.tier_ks is not None and np.asarray(self.tier_ks).size:
+            tree["tier_ks"] = np.asarray(self.tier_ks)
+            tree["tier_vs"] = np.asarray(self.tier_vs)
         return tree
 
     @classmethod
@@ -279,6 +332,15 @@ class ServingSnapshot:
             skipped_tokens=doc["skipped_tokens"],
             flight=list(doc.get("flight", [])),
             partial=bool(doc.get("partial", False)),
+            tier_keys=list(doc.get("tier_keys", [])),
+            tier_k=(np.asarray(tree["tier_k"])
+                    if "tier_k" in tree else None),
+            tier_v=(np.asarray(tree["tier_v"])
+                    if "tier_v" in tree else None),
+            tier_ks=(np.asarray(tree["tier_ks"])
+                     if "tier_ks" in tree else None),
+            tier_vs=(np.asarray(tree["tier_vs"])
+                     if "tier_vs" in tree else None),
         )
         snap.validate()
         return snap

@@ -102,6 +102,7 @@ class Span:
     ``Clock.monotonic()`` readings from the owning tracer's clock."""
 
     name: str                        # phase: queue|admit|prefill|...
+    #                                  (tiered engines add demote|promote)
     t0: float
     t1: float
     lane: str = "host"               # Perfetto row: slot3, sched, ...

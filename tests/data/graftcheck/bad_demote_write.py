@@ -1,0 +1,36 @@
+"""Seeded-bad fixture: a tier promotion upload that WRITES a shared
+(demoted-then-promoted and mounted) page.
+
+Same ``GRAFTCHECK_ALIAS_AUDIT`` hook protocol as the repo's own alias
+scenarios (analysis/alias.py): ``build()`` returns
+``(fn, args, pool_argnums, pool_outnums, shared_pages)``. The jitted
+"promotion upload" here scatters the DRAM payload at page ids [1, 2]
+while page 1 is declared shared — the exact bookkeeping slip the tier
+admission path could introduce (handing the upload the RESIDENT half of
+a part-demoted match path instead of only the freshly-reserved promo
+pages). Every slot mounting page 1 would silently read the re-uploaded
+bytes as its prefix — stale-by-one-demotion KV, no crash, corrupted
+streams — which is why the audit byte-compares the declared pages
+instead of trusting the admission bookkeeping.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _build():
+    # [L, n_pages, page_size, Hkv, hd] — the serving pool layout.
+    pool = jnp.zeros((2, 4, 8, 2, 4), jnp.float32)
+    payload = jnp.ones((2, 2, 8, 2, 4), jnp.float32)
+
+    @jax.jit
+    def promote_upload(pool, payload):
+        # BUG: page 1 is a resident page another slot mounts; only
+        # page 2 (and beyond) was freshly reserved for the promotion.
+        return (pool.at[:, jnp.asarray([1, 2])].set(payload),)
+
+    return promote_upload, (pool, payload), (0,), (0,), [1]
+
+
+GRAFTCHECK_ALIAS_AUDIT = [
+    ("promote_upload_writes_shared_page", _build),
+]

@@ -697,6 +697,25 @@ class TestAliasAudit:
         assert rules_of(findings) == {"shared-page-write"}
         assert "page(s) [1]" in findings[0].message
 
+    def test_bad_demote_write_fixture_caught(self):
+        """The tiering twin of the bad fixture above: a promotion
+        upload that scatters into a page another slot still mounts —
+        handing the upload the RESIDENT half of a part-demoted match
+        path instead of only the freshly-reserved promo pages — must
+        trip the same byte-compare (the CI graftcheck step runs this
+        fixture too)."""
+        from k8s_gpu_scheduler_tpu.analysis.alias import audit_shared_pages
+
+        sys.path.insert(0, FIXTURES)
+        try:
+            import bad_demote_write
+        finally:
+            sys.path.pop(0)
+        (name, build), = bad_demote_write.GRAFTCHECK_ALIAS_AUDIT
+        findings = audit_shared_pages(build, name)
+        assert rules_of(findings) == {"shared-page-write"}
+        assert "page(s) [1]" in findings[0].message
+
     def test_clean_writer_passes_and_vacuous_audit_does_not(self):
         import jax
         import jax.numpy as jnp

@@ -1,0 +1,517 @@
+"""Tiered KV-page cache: host-DRAM demotion + promote-ahead-of-decode
+behind the radix tree (models/paging.HostTierStore +
+prefix_cache.match_tiered/promote + the serving engine's step-boundary
+demotion drain and pre-prefill promotion upload).
+
+Proof obligations of the tiering PR:
+
+- **Token identity** — ``kv_tiering=True`` never changes a stream:
+  across dense/fused × int8-KV × speculative × chunked × tp, a trace
+  that forces full demote→promote round trips (pool too small, re-
+  submitted prompts) produces byte-identical output to the same engine
+  with tiering off. Promoted pages hold exactly the bytes the evicted
+  pages held (device→host readback, host→device re-upload — no
+  recompute, no requantize), so reuse through the tier must be
+  output-invisible.
+- **Lifecycle** — drain/restore/absorb carry the DRAM tier: a snapshot
+  with a populated tier resumes token-identically (same or smaller
+  ``dram_pages``, or an untiered target that simply drops the
+  sidecar), pre-tiering snapshots load unchanged, and absorbing a shed
+  slot whose prefix is DEMOTED on the target un-demotes it in place
+  (donated bytes equal the parked ones).
+- **Ordering** — demote-before-forget: a full tier degrades to the
+  plain eviction outcome (forget), never blocks admission; disk is
+  used only when DRAM is full; a match that races a PENDING demotion
+  cancels it in place (the retain pin wins, the copy never happens).
+- **Truthfulness** — ``digest()`` tier-flags demoted paths (3-tuples),
+  ``assert_consistent`` holds through every scenario, and the router
+  scores a demoted-path match strictly between a resident match and a
+  cold miss (``DEMOTED_MATCH_DISCOUNT``), deterministically.
+"""
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_scheduler_tpu.fleet import (
+    MemoryStore, ReplicaSummary, Router, prefix_match_len,
+    prefix_match_parts, publish_summary, summarize,
+)
+from k8s_gpu_scheduler_tpu.fleet.router import DEMOTED_MATCH_DISCOUNT
+from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+from k8s_gpu_scheduler_tpu.models.paging import HostTierStore, PageAllocator
+from k8s_gpu_scheduler_tpu.models.prefix_cache import PrefixCache
+from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+from k8s_gpu_scheduler_tpu.models.snapshot import ServingSnapshot
+
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                              decode_attn="fused")
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def mk_engine(params, cfg, **kw):
+    """A pool deliberately too small for the workload's cached pages
+    (10 pages, ~5 per request): every later admission evicts, so with
+    tiering on the tier actually cycles."""
+    base = dict(n_slots=2, max_len=64, chunk=2, prefill_bucket=8,
+                kv_layout="paged", page_size=PAGE, n_pages=10,
+                kv_dtype="int8", prefix_cache=True)
+    base.update(kw)
+    return ContinuousBatcher(params, cfg, **base)
+
+
+def mk_prompts(cfg, n=3, seed=5):
+    """DISTINCT 28-token prompts (3 full pages each + a tail): no
+    cross-prompt sharing, so a re-submitted prompt can only hit via its
+    own — by then demoted — path."""
+    rng = np.random.default_rng(seed)
+    return [list(rng.integers(0, cfg.vocab, 28)) for _ in range(n)]
+
+
+def drive_seq(params, cfg, trace, prompts, max_new=8, eng=None, **kw):
+    """Run ``prompts[i] for i in trace`` ONE AT A TIME (each request
+    reaps — and with tiering demotes — before the next admits).
+    Returns (streams in trace order, engine)."""
+    if eng is None:
+        eng = mk_engine(params, cfg, **kw)
+    out = []
+    for i in trace:
+        rid = eng.submit(prompts[i], max_new=max_new)
+        done = {}
+        while eng.pending:
+            done.update(eng.step())
+        out.append(done[rid])
+    return out, eng
+
+
+# The canonical demote→promote trace: [0, 1, 2] fills the pool and
+# demotes prompt 0/1 pages; the re-submissions must promote them back.
+ROUND_TRIP = [0, 1, 2, 0, 1]
+
+
+# -- constructor validation ---------------------------------------------------
+
+class TestValidation:
+    def test_tiering_requires_paged_layout(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError, match="kv_layout='paged'"):
+            ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                              kv_tiering=True)
+
+    def test_tiering_requires_prefix_cache(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError, match="prefix_cache=True"):
+            mk_engine(params, cfg, prefix_cache=False, kv_tiering=True)
+
+    def test_tier_knobs_require_tiering(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError, match="kv_tiering=True"):
+            mk_engine(params, cfg, dram_pages=8)
+        with pytest.raises(ValueError, match="kv_tiering=True"):
+            mk_engine(params, cfg, kv_tier_disk="/tmp/nope")
+
+
+# -- token identity through demote→promote round trips ------------------------
+
+class TestTokenIdentity:
+    @pytest.mark.parametrize("impl,kvd,spec", [
+        # Tier-1 keeps the richest production cells (fused-int8, with
+        # and without speculation — the spec verify path re-walks the
+        # promoted pages); the remaining grid rides the slow marker
+        # like every other engine grid (unfiltered CI runs every cell).
+        ("fused", "int8", False),
+        ("fused", "int8", True),
+        pytest.param("dense", None, False, marks=pytest.mark.slow),
+        pytest.param("dense", "int8", True, marks=pytest.mark.slow),
+        pytest.param("fused", None, False, marks=pytest.mark.slow),
+    ])
+    def test_tiering_on_matches_tiering_off(self, setup, impl, kvd, spec):
+        cfg, params = setup
+        cfg = dataclasses.replace(cfg, decode_attn=impl)
+        prompts = mk_prompts(cfg)
+        kw = dict(kv_dtype=kvd, speculative=spec)
+        on, eng = drive_seq(params, cfg, ROUND_TRIP, prompts,
+                            kv_tiering=True, dram_pages=32, **kw)
+        off, _ = drive_seq(params, cfg, ROUND_TRIP, prompts, **kw)
+        assert on == off
+        m = eng.pool_metrics()
+        # The trace must actually exercise the tier — a pool that
+        # happened to fit everything would make this cell vacuous.
+        assert m["page_demotions_total"] > 0
+        assert m["page_promotions_total"] > 0
+        assert m["tier_dram_pages"] > 0
+        eng._alloc.assert_consistent()
+
+    @pytest.mark.slow
+    def test_tiering_identity_on_tp_island(self, setup):
+        """The sharded cell: demote→promote round trips through a tp=2
+        island (readback gathers the sharded pool, the promotion upload
+        re-shards) — streams identical to the untiered island."""
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip(f"needs 2 devices, have {len(devs)}")
+        cfg, params = setup
+        mesh = Mesh(np.array(devs[:2]), ("tp",))
+        prompts = mk_prompts(cfg)
+        on, eng = drive_seq(params, cfg, ROUND_TRIP, prompts, mesh=mesh,
+                            kv_tiering=True, dram_pages=32)
+        off, _ = drive_seq(params, cfg, ROUND_TRIP, prompts, mesh=mesh)
+        assert on == off
+        assert eng.pool_metrics()["page_promotions_total"] > 0
+        eng._alloc.assert_consistent()
+
+    def test_promotion_actually_skips_prefill(self, setup):
+        """The point of the feature: the re-submitted prompts' full-page
+        prefixes are served from the tier (skipped tokens grow by the
+        promoted pages), not re-prefilled."""
+        cfg, params = setup
+        prompts = mk_prompts(cfg)
+        _, eng = drive_seq(params, cfg, ROUND_TRIP, prompts,
+                           kv_tiering=True, dram_pages=32)
+        m = eng.pool_metrics()
+        assert m["prefill_tokens_skipped"] \
+            >= m["page_promotions_total"] * PAGE > 0
+        # The promoted-hit histogram feed drained once, nonzero.
+        batch = m["promoted_hit_token_batch"]
+        assert batch and all(t > 0 for t in batch)
+        assert "promoted_hit_token_batch" not in eng.pool_metrics()
+
+
+# -- lifecycle: drain / restore / absorb with a populated tier ----------------
+
+class TestLifecycle:
+    def _warm_tiered(self, params, cfg, prompts, **kw):
+        """An engine whose tier is POPULATED (the [0,1,2] prefix of the
+        round trip) with the re-submissions still queued, stepped once
+        so a slot is mid-stream at drain time."""
+        out, eng = drive_seq(params, cfg, [0, 1, 2], prompts,
+                             kv_tiering=True, dram_pages=32, **kw)
+        rids = [eng.submit(prompts[i], max_new=8) for i in (0, 1)]
+        done = {}
+        done.update(eng.step())
+        return eng, rids, done, out
+
+    def test_restore_with_populated_tier(self, setup):
+        cfg, params = setup
+        prompts = mk_prompts(cfg)
+        ref, _ = drive_seq(params, cfg, ROUND_TRIP, prompts,
+                           kv_tiering=True, dram_pages=32)
+        eng, rids, done, out = self._warm_tiered(params, cfg, prompts)
+        snap = eng.drain()
+        assert len(snap.tier_keys) > 0          # the tier actually shipped
+        snap = ServingSnapshot.from_pytree(snap.to_pytree())
+        fresh = mk_engine(params, cfg, kv_tiering=True, dram_pages=32)
+        fresh.restore(snap)
+        while fresh.pending:
+            done.update(fresh.step())
+        assert out + [done[r] for r in rids] == ref
+        fresh._alloc.assert_consistent()
+        # The resumed engine can still PROMOTE from the restored tier.
+        extra, _ = drive_seq(params, cfg, [2], prompts, eng=fresh)
+        assert extra == [ref[2]]
+        assert fresh.pool_metrics()["page_promotions_total"] > 0
+
+    @pytest.mark.slow  # tier-1 keeps the populated-tier restore above
+    @pytest.mark.parametrize("restore_kw", [
+        dict(kv_tiering=True, dram_pages=4),    # smaller budget: hot tail
+        dict(),                                 # untiered: sidecar dropped
+    ])
+    def test_restore_into_different_tier_budget(self, setup, restore_kw):
+        """The tier is a CACHE: a target with a smaller DRAM budget
+        keeps the hottest tail, an untiered target drops the sidecar —
+        both resume token-identically."""
+        cfg, params = setup
+        prompts = mk_prompts(cfg)
+        ref, _ = drive_seq(params, cfg, ROUND_TRIP, prompts,
+                           kv_tiering=True, dram_pages=32)
+        eng, rids, done, out = self._warm_tiered(params, cfg, prompts)
+        snap = ServingSnapshot.from_pytree(eng.drain().to_pytree())
+        fresh = mk_engine(params, cfg, **restore_kw)
+        fresh.restore(snap)
+        while fresh.pending:
+            done.update(fresh.step())
+        assert out + [done[r] for r in rids] == ref
+        fresh._alloc.assert_consistent()
+        m = fresh.pool_metrics()
+        if restore_kw:
+            assert m["tier_dram_pages"] <= 4
+        else:
+            assert "tier_dram_pages" not in m
+
+    def test_pre_tiering_snapshot_loads_unchanged(self, setup):
+        """Back-compat both ways: an untiered engine's snapshot (no
+        tier fields in its pytree — the PR 9 absent-field convention)
+        restores into a TIERED engine, which then tiers as usual."""
+        cfg, params = setup
+        prompts = mk_prompts(cfg)
+        ref, _ = drive_seq(params, cfg, ROUND_TRIP, prompts)
+        eng = mk_engine(params, cfg)
+        out, eng = drive_seq(params, cfg, [0, 1, 2], prompts, eng=eng)
+        rids = [eng.submit(prompts[i], max_new=8) for i in (0, 1)]
+        done = {}
+        done.update(eng.step())
+        tree = eng.drain().to_pytree()
+        # A pre-tiering writer never emitted tier entries at all:
+        # no payload arrays (true of any untiered drain), and no
+        # ``tier_keys`` in the metadata doc (stripped here to simulate
+        # an old-format snapshot byte-for-byte).
+        assert not [k for k in tree if "tier" in str(k)]
+        meta = json.loads(bytes(tree["meta_json"]).decode("utf-8"))
+        meta.pop("tier_keys", None)
+        tree["meta_json"] = np.frombuffer(
+            json.dumps(meta).encode("utf-8"), dtype=np.uint8).copy()
+        fresh = mk_engine(params, cfg, kv_tiering=True, dram_pages=32)
+        fresh.restore(ServingSnapshot.from_pytree(tree))
+        while fresh.pending:
+            done.update(fresh.step())
+        # NOTE: identity vs the UNTIERED reference — tiering preserved
+        # the streams even though the tiered engine demotes where the
+        # snapshot's writer forgot.
+        assert out + [done[r] for r in rids] == ref
+        fresh._alloc.assert_consistent()
+
+    @pytest.mark.slow  # unfiltered CI runs it; tier-1 lifecycle is the
+    # populated-tier restore + the pre-tiering back-compat cell
+    def test_absorb_shed_slot_demoted_on_target(self, setup):
+        """The shed slot's prefix path is DEMOTED on the target: the
+        absorbed request finishes identically, and its reap-time
+        donation un-demotes the target's nodes in place (donated bytes
+        equal the parked ones — the tier copy is discarded, not
+        duplicated)."""
+        cfg, params = setup
+        prompts = mk_prompts(cfg)
+        ref, _ = drive_seq(params, cfg, ROUND_TRIP, prompts)
+        # Target: tier populated, prompt-0 path demoted.
+        _, dst = drive_seq(params, cfg, [0, 1, 2], prompts,
+                           kv_tiering=True, dram_pages=32)
+        assert dst._prefix.demoted_count > 0
+        # Prompt 0's path is (partially) demoted on the target —
+        # leaf-first eviction demotes its deepest chunks first.
+        _, demoted = dst._prefix.match_tiered(prompts[0] + [0],
+                                              count=False)
+        assert demoted
+        promos_before = dst.pool_metrics()["page_promotions_total"]
+        # Source: an UNTIERED twin serving prompt 0, shed mid-stream.
+        src = mk_engine(params, cfg)
+        rid = src.submit(prompts[0], max_new=8)
+        src.step()
+        snap = ServingSnapshot.from_pytree(
+            src.drain(slots=src.active_slot_ids()).to_pytree())
+        mapping = dst.absorb(snap)
+        src._alloc.assert_consistent()
+        dst._alloc.assert_consistent()
+        done = {}
+        while dst.pending:
+            done.update(dst.step())
+        assert done[mapping[rid]] == ref[0]
+        dst._alloc.assert_consistent()
+        # Reap donated prompt 0's resident pages over its demoted
+        # nodes: the full path is resident again, and it got there
+        # through the DONATION un-demote (tier copy discarded) — no
+        # promotion upload ever ran for it.
+        path, demoted = dst._prefix.match_tiered(prompts[0] + [0],
+                                                 count=False)
+        assert demoted == [] and len(path) == 3 \
+            and all(p is not None for p in path)
+        assert dst.pool_metrics()["page_promotions_total"] \
+            == promos_before
+
+
+# -- ordering: demote-before-forget, disk spill, the pending-match race -------
+
+class TestOrdering:
+    def test_full_tier_degrades_to_forget_never_blocks(self, setup):
+        """dram_pages=2 cannot hold the workload's evictions: the
+        overflow is FORGOTTEN (the plain eviction outcome) while
+        admission keeps flowing, and streams still match tiering-off."""
+        cfg, params = setup
+        prompts = mk_prompts(cfg, n=4)
+        trace = [0, 1, 2, 3, 0]
+        on, eng = drive_seq(params, cfg, trace, prompts,
+                            kv_tiering=True, dram_pages=2)
+        off, _ = drive_seq(params, cfg, trace, prompts)
+        assert on == off
+        m = eng.pool_metrics()
+        assert m["page_demotions_total"] > 0
+        assert m["tier_forgotten_total"] > 0    # demote-before-forget
+        assert m["tier_dram_pages"] <= 2        # budget held throughout
+        eng._alloc.assert_consistent()
+
+    @pytest.mark.slow  # disk tier is off by default; unfiltered CI runs it
+    def test_disk_spills_only_when_dram_full(self, setup, tmp_path):
+        """Third tier, off by default: with a roomy DRAM budget the
+        disk directory stays EMPTY; with a tiny one the coldest entries
+        spill to disk instead of being forgotten — and a re-submitted
+        prompt promotes straight from disk, token-identically."""
+        cfg, params = setup
+        prompts = mk_prompts(cfg)
+        roomy = tmp_path / "roomy"
+        tiny = tmp_path / "tiny"
+        on, eng = drive_seq(params, cfg, ROUND_TRIP, prompts,
+                            kv_tiering=True, dram_pages=32,
+                            kv_tier_disk=str(roomy))
+        m = eng.pool_metrics()
+        assert m["tier_spills_total"] == 0 and m["tier_disk_pages"] == 0
+        assert not any(os.scandir(roomy)) if roomy.exists() else True
+        on2, eng2 = drive_seq(params, cfg, ROUND_TRIP, prompts,
+                              kv_tiering=True, dram_pages=2,
+                              kv_tier_disk=str(tiny))
+        off, _ = drive_seq(params, cfg, ROUND_TRIP, prompts)
+        assert on == on2 == off
+        m2 = eng2.pool_metrics()
+        assert m2["tier_spills_total"] > 0
+        assert m2["page_promotions_total"] > 0  # promoted THROUGH disk
+        eng2._alloc.assert_consistent()
+
+    def test_pending_match_race_cancels_demotion(self):
+        """A match that crosses a PENDING demotion (bytes not yet
+        drained off-pool) un-demotes it in place: the retain pin wins,
+        the readback is cancelled, nothing is copied."""
+        alloc = PageAllocator(8)
+        tier = HostTierStore(16)
+        cache = PrefixCache(alloc, 4, tier=tier)
+        pages = alloc.alloc(2)
+        toks = list(range(8))
+        cache.insert(toks, pages)
+        assert cache.evict(2) == 2
+        assert tier.metrics()["tier_pending_demotions"] == 2
+        assert cache.demoted_count == 2
+        path, demoted = cache.match_tiered(toks + [99])
+        assert demoted == [] and path == pages
+        m = tier.metrics()
+        assert m["tier_cancelled_demotions"] == 2
+        assert m["tier_pending_demotions"] == 0
+        # A cancelled enqueue never counts as a demotion: the bytes
+        # never left the pool.
+        assert m["page_demotions_total"] == 0
+        assert len(cache) == 2 and cache.demoted_count == 0
+        alloc.assert_consistent()
+
+
+# -- truthfulness: digest tier flags + router scoring -------------------------
+
+class TestDigestAndRouter:
+    def test_digest_tier_flags_demoted_paths(self, setup):
+        """A tiered replica's digest entries are 3-tuples whose
+        resident length is strictly below the cached length on a
+        demoted path; untiered digests stay 2-tuples (wire
+        back-compat)."""
+        cfg, params = setup
+        prompts = mk_prompts(cfg)
+        _, eng = drive_seq(params, cfg, [0, 1, 2], prompts,
+                           kv_tiering=True, dram_pages=32)
+        s = summarize(eng, "r0")
+        assert s.dram_cached_pages > 0
+        assert all(len(e) == 3 for e in s.digest)
+        assert any(e[2] < e[1] for e in s.digest), s.digest
+        assert all(0 <= e[2] <= e[1] for e in s.digest)
+        _, flat = drive_seq(params, cfg, [0, 1, 2], prompts)
+        s2 = summarize(flat, "r1")
+        assert s2.dram_cached_pages == 0
+        assert all(len(e) == 2 for e in s2.digest)
+
+    def test_summary_json_back_compat(self):
+        """PR 9 convention: absent fields default, old payloads parse.
+        A pre-tiering JSON (no dram_cached_pages, 2-element digest
+        entries) round-trips; mixed 2/3-element digests survive the
+        codec."""
+        s = ReplicaSummary(replica="r1", fleet="f", page_size=PAGE,
+                           pages_total=32, pages_free=10,
+                           dram_cached_pages=7,
+                           digest=[([1, 2, 3], 8), ([4, 5], 16, 8)])
+        got = ReplicaSummary.from_json(s.to_json())
+        assert got == s
+        old = json.loads(s.to_json())
+        del old["dram_cached_pages"]
+        old["digest"] = [[[1, 2, 3], 8]]
+        legacy = ReplicaSummary.from_json(json.dumps(old))
+        assert legacy.dram_cached_pages == 0
+        assert legacy.digest == [([1, 2, 3], 8)]
+
+    def test_prefix_match_parts_split_and_tiebreak(self):
+        path = list(range(100, 124))            # 3 pages cached
+        # 3-tuple: 8 of the 24 cached tokens resident.
+        digest = [(path, 24, 8)]
+        m, r = prefix_match_parts(path[:20] + [1, 2], digest, PAGE)
+        assert (m, r) == (16, 8)
+        # Full cover: the last-page cap applies to BOTH parts.
+        m, r = prefix_match_parts(path, digest, PAGE)
+        assert (m, r) == (16, 8)
+        # 2-tuple (untiered / pre-tiering): fully resident.
+        assert prefix_match_parts(path + [7], [(path, 24)], PAGE) \
+            == (24, 24)
+        assert prefix_match_len(path + [7], digest, PAGE) == 24
+        # Equal total match: the MORE-RESIDENT entry wins the tie.
+        two = [(path, 24, 0), (path, 24, 24)]
+        assert prefix_match_parts(path + [7], two, PAGE) == (24, 24)
+
+    def _summaries(self, prompt):
+        base = dict(fleet="f", published_wall=0.0, page_size=PAGE,
+                    pages_total=32, pages_free=32, n_slots=4,
+                    active_slots=0)
+        cached = 2 * PAGE
+        return {
+            "cold": ReplicaSummary(replica="cold", **base),
+            "demoted": ReplicaSummary(
+                replica="demoted",
+                digest=[(prompt[:cached], cached, 0)], **base),
+            "resident": ReplicaSummary(
+                replica="resident",
+                digest=[(prompt[:cached], cached)], **base),
+        }
+
+    def test_router_scores_demoted_between_resident_and_cold(self, setup):
+        """The satellite ordering: for the same digest path at equal
+        load, resident > demoted > cold — a demoted match saves the
+        prefill compute but pays the promotion upload."""
+        cfg, params = setup
+        r = Router([("r0", mk_engine(params, cfg)),
+                    ("r1", mk_engine(params, cfg))])
+        prompt = list(range(3 * PAGE)) + [7]
+        subs = self._summaries(prompt)
+        s_cold, m_cold = r.score(subs["cold"], prompt)
+        s_dem, m_dem = r.score(subs["demoted"], prompt)
+        s_res, m_res = r.score(subs["resident"], prompt)
+        assert m_cold == 0 and m_dem == m_res == 2 * PAGE
+        assert s_res > s_dem > s_cold
+        assert 0.0 < DEMOTED_MATCH_DISCOUNT < 1.0
+
+    def test_routing_with_tier_flags_is_deterministic(self, setup):
+        """Same summaries (tier flags included), same placements —
+        byte-identical stores route an identical prompt sequence
+        identically, and the demoted-path replica actually attracts
+        its own prompts over a cold twin."""
+        cfg, params = setup
+        rng = np.random.default_rng(13)
+        hot = list(rng.integers(0, cfg.vocab, 2 * PAGE))
+        prompts = [hot + list(rng.integers(0, cfg.vocab, 2 + i % 5))
+                   for i in range(10)]
+
+        def placements():
+            r = Router([("r0", mk_engine(params, cfg)),
+                        ("r1", mk_engine(params, cfg))])
+            base = dict(fleet=r.fleet, page_size=PAGE, pages_total=32,
+                        pages_free=32, n_slots=4, active_slots=0,
+                        published_wall=r._clock.wall())
+            publish_summary(r._store, ReplicaSummary(
+                replica="r0", dram_cached_pages=2,
+                digest=[(hot, 2 * PAGE, 0)], **base))
+            publish_summary(r._store, ReplicaSummary(
+                replica="r1", **base))
+            return [r.route(p) for p in prompts]
+
+        first = placements()
+        assert first == placements()
+        assert {rid for rid, _, _ in first} == {"r0"}
+        assert {pol for _, pol, _ in first} == {"affinity"}

@@ -273,6 +273,54 @@ class TestServingPoolExport:
         assert (f'{PREFIX_HIT_HISTOGRAM}_count{{replica="r0"}} 1'
                 in reg2.expose())
 
+    def test_tier_gauges_and_promoted_histogram(self):
+        """The KV-tiering metrics surface: tier occupancy/churn gauges
+        ride tpu_serve_* like every pool key, promoted-hit tokens fold
+        into the tpu_serve_promoted_hit_tokens HISTOGRAM (drained-once
+        batch like the phase batch), and an untiered snapshot's
+        exposition stays byte-identical — the tier keys exist only on
+        tiered engines, so absence is structural, not filtered."""
+        from k8s_gpu_scheduler_tpu.metrics import export_serving_pool
+        from k8s_gpu_scheduler_tpu.metrics.exporter import (
+            PROMOTED_HIT_HISTOGRAM, SERVING_POOL_GAUGES,
+        )
+
+        for key in ("tier_dram_pages", "tier_dram_capacity",
+                    "tier_disk_pages", "tier_pending_demotions",
+                    "page_demotions_total", "page_promotions_total",
+                    "prefix_demoted_pages", "tier_spills_total",
+                    "tier_forgotten_total", "tier_cancelled_demotions"):
+            assert key in SERVING_POOL_GAUGES, key
+        reg = Registry()
+        export_serving_pool(reg, {
+            "tier_dram_pages": 52.0, "tier_dram_capacity": 64.0,
+            "page_demotions_total": 100.0,
+            "page_promotions_total": 48.0,
+            "prefix_demoted_pages": 52.0,
+            "promoted_hit_token_batch": (8, 32, 384),
+        })
+        text = reg.expose()
+        assert "tpu_serve_tier_dram_pages 52.0" in text
+        assert "tpu_serve_tier_dram_capacity 64.0" in text
+        assert "tpu_serve_page_demotions_total 100.0" in text
+        assert "tpu_serve_page_promotions_total 48.0" in text
+        assert "tpu_serve_prefix_demoted_pages 52.0" in text
+        assert f"{PROMOTED_HIT_HISTOGRAM}_count 3" in text
+        assert f"{PROMOTED_HIT_HISTOGRAM}_sum 424.0" in text
+        # Labeled (fleet) edition rides the same machinery.
+        reg2 = Registry()
+        export_serving_pool(reg2, {"promoted_hit_token_batch": (64,)},
+                            labels={"replica": "r0"})
+        assert (f'{PROMOTED_HIT_HISTOGRAM}_count{{replica="r0"}} 1'
+                in reg2.expose())
+        # Untiered snapshot: no tier/promoted series at all.
+        reg3 = Registry()
+        export_serving_pool(reg3, {"pages_free": 20.0,
+                                   "prefix_hit_rate": 0.8})
+        text3 = reg3.expose()
+        assert "tier" not in text3 and "promot" not in text3
+        assert "demot" not in text3
+
     def test_weight_gauges_and_tp_combine_info(self):
         """Megatron-sliced weights' metrics surface: per-chip weight
         residency gauges (build-time constants, the kv_pool contract)
