@@ -16,14 +16,17 @@ native:
 # graftcheck fast passes (AST lint incl. retry-lint + trace-lint
 # [trace-in-jit] + the suppression-policy lint [bare-suppression], the
 # lock-order & donated-buffer audit [lock-cycle / use-after-donate /
-# torn-snapshot], Pallas VMEM budgeter — no tracing; the same gate
-# tier-1 runs via tests/test_graftcheck_clean.py) plus the GSPMD
+# torn-snapshot], the determinism lint over the replay/placement planes
+# [unseeded-rng / builtin-hash / unordered-iteration /
+# wall-clock-decision], Pallas VMEM budgeter — no tracing; the same
+# gate tier-1 runs via tests/test_graftcheck_clean.py) plus the GSPMD
 # sharding audit (--gspmd: tracing-only walk of the sharded entry
 # points against the parallel/sharding.py rules table — no compilation,
-# seconds). The full ten-pass analyzer (jaxpr audit + recompile/donation
-# guard + alias audit + gspmd + the symbolic HBM-traffic/residency
-# audit against the TRAFFIC_CONTRACTS registry) is
-# `$(PY) -m k8s_gpu_scheduler_tpu.analysis` with no flags.
+# seconds). The full twelve-pass analyzer (jaxpr audit +
+# recompile/donation guard + alias audit + gspmd + the symbolic
+# HBM-traffic/residency audit against the TRAFFIC_CONTRACTS registry +
+# the wire-format schema audit against tests/data/graftcheck/schemas/)
+# is `$(PY) -m k8s_gpu_scheduler_tpu.analysis` with no flags.
 lint:
 	$(PY) -m k8s_gpu_scheduler_tpu.analysis --fast --gspmd
 

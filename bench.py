@@ -1138,10 +1138,12 @@ def bench_analysis(smoke=False):
     repo, recorded in BENCH_r*.json so lint latency is a tracked metric —
     a pass that quietly grows from 2 s to 2 minutes is a CI tax nobody
     budgeted. ``--smoke`` (and the headline value either way) times the
-    FAST passes (AST lint + lock-order + VMEM — what tier-1 runs every
-    collection); the full ten-pass wall time (jaxpr, recompile, alias,
-    gspmd, symbolic traffic) rides in ``extra`` unless smoking, one
-    ``analysis_<pass>_s`` key per pass."""
+    FAST passes (AST lint + lock-order + determinism + VMEM — what
+    tier-1 runs every collection); the full twelve-pass wall time
+    (jaxpr, recompile, alias, gspmd, symbolic traffic, wirecompat)
+    rides in ``extra`` unless smoking, one ``analysis_<pass>_s`` key
+    per pass (so ``analysis_determinism_s`` / ``analysis_wirecompat_s``
+    flow with the rest)."""
     if not smoke:
         # Mirror the CLI's env (analysis/__main__.py): the traced passes
         # want hermetic CPU and a multi-device mesh for the pipeline entry

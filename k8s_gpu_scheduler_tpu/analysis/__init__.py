@@ -1,6 +1,6 @@
 """graftcheck — static analysis for the jax_graft serving/training stack.
 
-Ten coordinated passes over the repo (``python -m
+Twelve coordinated passes over the repo (``python -m
 k8s_gpu_scheduler_tpu.analysis``; importable APIs below):
 
 1. **AST lint** (``astlint``): jit-hostile patterns (tracer casts, host
@@ -66,6 +66,28 @@ k8s_gpu_scheduler_tpu.analysis``; importable APIs below):
    ``# graftcheck: ignore[rule]`` with no rationale is itself a
    finding, and the README suppression catalogue is regenerated from
    the tree (``--suppressions``).
+11. **Wire-format schema audit** (``wirecompat``): builds every wire
+   artifact — ``ServingSnapshot`` pytree + host meta doc,
+   ``ReplicaSummary`` JSON, the ``RequestJournal`` doc — from a
+   registry of audit constructors, extracts the live schema (leaf
+   dtypes/ranks, doc keys, per-field decoder-has-a-default probed by
+   deletion), and diffs it against the committed goldens under
+   ``tests/data/graftcheck/schemas/``. Rules: ``wire-break`` (field
+   removed or dtype/rank changed — an old artifact stops loading),
+   ``wire-no-default`` (new field whose decoder has no default — a
+   NEW decoder rejects OLD artifacts), ``wire-golden-stale`` (any
+   other drift; regenerate with ``--update-schemas`` after review).
+   Runs in the full CLI; CI asserts the clean tree AND that
+   ``--update-schemas`` is a git no-op.
+12. **Determinism lint** (``determinism``, fast): over the modules
+   whose determinism is load-bearing (fleet routing/health/replay,
+   the fault injector, snapshot/prefix/paging, the scheduler scoring
+   path) — ``unseeded-rng`` (entropy-seeded or module-global RNGs),
+   ``builtin-hash`` (PYTHONHASHSEED-dependent keys; the PR 6 crc32
+   fix as a rule), ``unordered-iteration`` (set iteration feeding an
+   ordered decision), ``wall-clock-decision`` (raw ``time.*`` reads
+   where the injectable Clock seam is the contract). Rides ``make
+   lint`` and the tier-1 clean gate.
 
 Suppression: ``# graftcheck: ignore[rule]`` on the offending line, with a
 rationale in the surrounding comment (policy in README; enforced by
@@ -85,8 +107,16 @@ from .lockorder import lint_lockorder_source, run_lockorder
 from .traffic import (
     TrafficContract, audit_traffic_callable, audit_traffic_jaxpr,
 )
+from .determinism import (
+    DETERMINISM_SCOPE, in_determinism_scope, lint_determinism_source,
+    run_determinism,
+)
 from .retrylint import lint_retry
 from .tracelint import lint_trace_calls
+from .wirecompat import (
+    WIRE_ARTIFACTS, default_schema_dir, diff_schemas, extract_schemas,
+    load_golden, write_goldens,
+)
 from .vmem import (
     VMEM_BYTES_PER_CORE, audit_vmem, decode_attention_footprint,
     flash_attention_footprint, paged_decode_attention_footprint,
@@ -118,18 +148,26 @@ __all__ = [
     "TrafficContract",
     "audit_traffic_callable",
     "audit_traffic_jaxpr",
+    "DETERMINISM_SCOPE",
+    "in_determinism_scope",
+    "lint_determinism_source",
+    "run_determinism",
+    "diff_schemas",
+    "extract_schemas",
     "run_fast_passes",
     "run_gspmd_pass",
     "run_traced_passes",
     "run_traffic_pass",
+    "run_wirecompat_pass",
 ]
 
 
 def run_fast_passes(paths=None) -> Report:
-    """AST lint + VMEM budgeter — no tracing, suitable for collection-time
-    gating. ``paths`` defaults to the installed package directory. Files
-    defining ``GRAFTCHECK_VMEM_AUDIT`` (a list of ``(name, footprint)``
-    pairs) get their declared kernel footprints budget-checked too."""
+    """AST lint + lock-order + determinism lint + VMEM budgeter — no
+    tracing, suitable for collection-time gating. ``paths`` defaults to
+    the installed package directory. Files defining
+    ``GRAFTCHECK_VMEM_AUDIT`` (a list of ``(name, footprint)`` pairs)
+    get their declared kernel footprints budget-checked too."""
     import os
     import time
 
@@ -145,6 +183,7 @@ def run_fast_passes(paths=None) -> Report:
 
     t0 = time.perf_counter()
     lock_s = 0.0
+    det_s = 0.0
     for path in iter_python_files(paths):
         with open(path, "r", encoding="utf-8") as fh:
             source = fh.read()
@@ -157,8 +196,13 @@ def run_fast_passes(paths=None) -> Report:
             t1 = time.perf_counter()
             report.extend(lint_lockorder_source(path, source, tree=tree))
             lock_s += time.perf_counter() - t1
-    report.pass_seconds["astlint"] = time.perf_counter() - t0 - lock_s
+            t1 = time.perf_counter()
+            report.extend(lint_determinism_source(path, source, tree=tree))
+            det_s += time.perf_counter() - t1
+    report.pass_seconds["astlint"] = (time.perf_counter() - t0
+                                      - lock_s - det_s)
     report.pass_seconds["lockorder"] = lock_s
+    report.pass_seconds["determinism"] = det_s
     t0 = time.perf_counter()
     report.extend(audit_vmem())
     for src, _attr, entries in _discover_hooks(
@@ -250,6 +294,56 @@ def run_traced_passes(paths=None) -> Report:
     traffic = run_traffic_pass(paths)
     report.findings.extend(traffic.findings)
     report.pass_seconds.update(traffic.pass_seconds)
+
+    wire = run_wirecompat_pass(paths)
+    report.findings.extend(wire.findings)
+    report.pass_seconds.update(wire.pass_seconds)
+    return report
+
+
+def run_wirecompat_pass(paths=None, schema_dir=None,
+                        update: bool = False) -> Report:
+    """Wire-format schema-compatibility audit (analysis/wirecompat.py)
+    over the wire-artifact registry plus any
+    ``GRAFTCHECK_WIRECOMPAT_AUDIT`` hooks found in ``paths`` (entries
+    are ``(name, live_schema, golden_schema)`` triples; ``live_schema``
+    may be a callable). Host-only (numpy, no tracing) but folded into
+    the full CLI run like gspmd/traffic; ``update=True`` rewrites the
+    goldens instead of diffing (the CLI's ``--update-schemas``)."""
+    import time
+
+    from .wirecompat import (
+        default_schema_dir, diff_schemas, extract_schemas, load_golden,
+        write_goldens,
+    )
+
+    report = Report()
+    t0 = time.perf_counter()
+    if schema_dir is None:
+        schema_dir = default_schema_dir()
+    live = extract_schemas(report)
+    if update:
+        write_goldens(live, schema_dir)
+    else:
+        for name, schema in live.items():
+            golden = load_golden(name, schema_dir)
+            report.extend(diff_schemas(name, schema, golden,
+                                       anchor=f"<wire:{name}>"))
+    for src, attr, entries in _discover_hooks(
+            paths, ("GRAFTCHECK_WIRECOMPAT_AUDIT",)):
+        for entry in _safe_entries(report, src, attr, entries, arity=3):
+            name, live_schema, golden_schema = entry
+            try:
+                if callable(live_schema):
+                    live_schema = live_schema()
+                report.extend(diff_schemas(name, dict(live_schema),
+                                           dict(golden_schema), anchor=src))
+            except Exception as e:  # noqa: BLE001 — a broken hook is a finding
+                report.extend([Finding(
+                    "hook-error", src, 0,
+                    f"{attr}: bad schema entry for {name}: "
+                    f"{type(e).__name__}: {e}")])
+    report.pass_seconds["wirecompat"] = time.perf_counter() - t0
     return report
 
 

@@ -1,17 +1,22 @@
 """graftcheck CLI — ``python -m k8s_gpu_scheduler_tpu.analysis [paths...]``.
 
-Default: all ten passes (AST lint incl. retry/trace/suppression lints,
-lock-order audit, VMEM budgeter, jaxpr audit, recompile guard, alias
-audit, GSPMD audit, symbolic traffic audit) over the package tree plus
-any extra ``paths``. Exit code 0 iff no error-severity findings;
-findings print as ``file:line: [rule] message``.
+Default: all twelve passes (AST lint incl. retry/trace/suppression
+lints, lock-order audit, determinism lint, VMEM budgeter, jaxpr audit,
+recompile guard, alias audit, GSPMD audit, symbolic traffic audit,
+wire-format schema audit) over the package tree plus any extra
+``paths``. Exit code 0 iff no error-severity findings; findings print
+as ``file:line: [rule] message``.
 
-``--fast`` runs only the AST + lock-order + VMEM passes (no jax
-tracing) — what ``make lint`` and the tier-1 gate use. ``--json`` emits
-a machine-readable summary line whose ``findings`` key is the full list
-(stable schema: rule, path, line, severity, message) so CI can annotate
-instead of grepping text. ``--suppressions`` prints the suppression
-catalogue (the README block is regenerated from it, drift-tested).
+``--fast`` runs only the AST + lock-order + determinism + VMEM passes
+(no jax tracing) — what ``make lint`` and the tier-1 gate use.
+``--json`` emits a machine-readable summary line whose ``findings`` key
+is the full list (stable schema: rule, path, line, severity, message)
+so CI can annotate instead of grepping text. ``--suppressions`` prints
+the suppression catalogue (the README block is regenerated from it,
+drift-tested). ``--update-schemas`` rewrites the committed wire-format
+goldens (tests/data/graftcheck/schemas/) from the live codecs and
+exits — the ONLY sanctioned way to move them; CI asserts it is a git
+no-op, so schema drift must arrive with its golden in the same commit.
 """
 from __future__ import annotations
 
@@ -42,6 +47,11 @@ def main(argv=None) -> int:
                         help="print the suppression catalogue (markdown "
                              "rows — the README block regenerates from "
                              "this) and exit")
+    parser.add_argument("--update-schemas", action="store_true",
+                        help="regenerate the committed wire-format golden "
+                             "schemas (tests/data/graftcheck/schemas/) "
+                             "from the live codecs and exit — review the "
+                             "diff; CI pins this to a git no-op")
     parser.add_argument("--warnings-as-errors", action="store_true")
     args = parser.parse_args(argv)
 
@@ -53,6 +63,20 @@ def main(argv=None) -> int:
 
         for row in suppression_catalogue(paths):
             print(row)
+        return 0
+
+    if args.update_schemas:
+        from . import run_wirecompat_pass
+
+        report = run_wirecompat_pass(paths, update=True)
+        if report.errors:
+            print(report.render(header="graftcheck --update-schemas:"),
+                  file=sys.stderr)
+            return 1
+        from .wirecompat import default_schema_dir
+
+        print(f"graftcheck: wire-format goldens rewritten under "
+              f"{default_schema_dir()}", file=sys.stderr)
         return 0
 
     if not args.fast or args.gspmd:

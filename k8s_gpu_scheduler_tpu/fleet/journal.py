@@ -22,6 +22,19 @@ entries leave the map immediately (bounded size: the journal holds
 in-flight state, not history) but their token counts stay in the
 monotonic counters the ``tpu_fleet_*`` metrics and the chaos bench's
 bounded-rework assertion read.
+
+WIRE-FORMAT CONTRACT (graftcheck pass 11, ``wirecompat``): the
+version-1 doc (top-level counters + per-entry ``JournalEntry`` fields)
+is what a restarted router finds on disk — it must parse journals
+written by the binary it replaced. The schema is pinned in
+``tests/data/graftcheck/schemas/request_journal.json``. Evolve by
+ADDING a ``JournalEntry`` field with a dataclass default (old docs
+decode through ``JournalEntry(**d)`` untouched), then regenerate the
+golden (``--update-schemas``) in the same change; removing or retyping
+a field, or touching the required top-level counters, needs a doc
+version bump with rationale. A PR 10-era doc is committed at
+``tests/data/wire/journal_pr10.json`` and must keep loading
+(tests/test_wire_compat.py).
 """
 from __future__ import annotations
 

@@ -34,6 +34,19 @@ kvstored to route, while production passes the real RESP client and the
 summaries ride the shared registry. Chaos tests wrap either in a
 ``FaultProxy`` to flap the summary plane and drive the router's
 degraded path.
+
+WIRE-FORMAT CONTRACT (graftcheck pass 11, ``wirecompat``): the
+``to_json`` field set is the registry heartbeat schema every router in
+the fleet parses — including routers a version behind the replica that
+published it. It is pinned in
+``tests/data/graftcheck/schemas/replica_summary.json``. Evolve by
+ADDING a dataclass field with a default (the
+``prefill_backlog_tokens``/``tp``/``weight_device_bytes``/
+``dram_cached_pages`` precedents above — each one kept older summaries
+parsing), then regenerate the golden (``--update-schemas``) in the
+same change; only ``replica`` may stay default-less. A PR 8-era
+heartbeat is committed at ``tests/data/wire/summary_pr8.json`` and
+must keep loading (tests/test_wire_compat.py).
 """
 from __future__ import annotations
 

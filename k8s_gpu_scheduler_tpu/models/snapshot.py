@@ -56,6 +56,20 @@ The snapshot runs through ``utils/checkpoint.py``'s orbax machinery via
 host bookkeeping rides as one JSON document encoded to uint8), so
 ``TrainCheckpointer.save(step, snap.to_pytree())`` just works and the
 restore side needs no custom readers.
+
+WIRE-FORMAT CONTRACT (graftcheck pass 11, ``wirecompat``): the pytree
+leaves and the meta-doc keys ARE a wire format — shed snapshots ship
+between replicas, and the cross-process fleet item makes them literal
+network bytes. Their schema is pinned in
+``tests/data/graftcheck/schemas/serving_snapshot.json`` at
+``SNAPSHOT_VERSION`` = 1. Evolve by ADDING a field whose ``from_pytree``
+default preserves old artifacts (the ``payload_shape`` /
+``flight`` / ``partial`` / tier-sidecar precedents above), then
+regenerate the golden (``python -m k8s_gpu_scheduler_tpu.analysis
+--update-schemas``) in the same change; removing or retyping a field
+requires a ``SNAPSHOT_VERSION`` bump with rationale. A pre-tiering
+drain is committed at ``tests/data/wire/snapshot_pre_tiering.npz`` and
+must keep loading (tests/test_wire_compat.py).
 """
 from __future__ import annotations
 

@@ -1,0 +1,59 @@
+"""Seeded-bad fixture for the determinism lint (analysis/determinism.py).
+
+Never imported by the package — it exists so tests/CI can prove the
+pass would catch each nondeterminism class if it landed on the
+replay/placement planes. The file opts into the scope via the
+``GRAFTCHECK_DETERMINISM_LINT`` marker (it does not live under fleet/).
+Planted true positives:
+
+- ``unseeded-rng`` ×3: a ``random.Random()`` with no seed (OS entropy —
+  replay diverges), a module-global ``random.choice`` (one hidden RNG
+  shared across callers/threads), and an unseeded
+  ``np.random.default_rng()``.
+- ``builtin-hash``: routing keyed on ``hash()`` — PYTHONHASHSEED-salted,
+  so two replicas disagree about the same request.
+- ``unordered-iteration`` ×2: victim selection appending out of a set,
+  and first-match selection returning out of set algebra.
+- ``wall-clock-decision``: an expiry decision on a raw ``time.time()``
+  read instead of the injectable Clock seam.
+"""
+import random
+import time
+
+import numpy as np
+
+GRAFTCHECK_DETERMINISM_LINT = True   # opt into the scoped pass
+
+
+class BadFailoverPlanner:
+    """Every decision below is one a survivor must replay identically."""
+
+    def __init__(self):
+        self._replicas = {"r0", "r1", "r2"}
+        self._rng = random.Random()                 # unseeded-rng
+
+    def pick_victims(self, n):
+        victims = []
+        for r in self._replicas:                    # unordered-iteration
+            victims.append(r)
+            if len(victims) == n:
+                break
+        return victims
+
+    def first_live(self, dead):
+        for r in self._replicas - dead:             # unordered-iteration
+            return r
+        return None
+
+    def route_key(self, prompt):
+        return hash(tuple(prompt)) % 8              # builtin-hash
+
+    def jitter_s(self):
+        g = np.random.default_rng()                 # unseeded-rng
+        return float(g.uniform(0.0, 0.05))
+
+    def tie_break(self, candidates):
+        return random.choice(candidates)            # unseeded-rng (global)
+
+    def expired(self, deadline_wall):
+        return time.time() > deadline_wall          # wall-clock-decision

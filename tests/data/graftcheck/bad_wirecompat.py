@@ -1,0 +1,47 @@
+"""Seeded-bad fixture for the wire-format schema audit
+(analysis/wirecompat.py).
+
+Never imported by the package — it registers a
+``GRAFTCHECK_WIRECOMPAT_AUDIT`` hook (``(name, live_schema,
+golden_schema)`` triples; the live entry may be a callable) describing
+a toy telemetry record whose live schema drifted from its committed
+golden in every way the pass classifies:
+
+- ``wire-break`` ×2: ``gpu_uuid`` was REMOVED from the live format
+  (artifacts already on the wire stop loading), and ``util`` changed
+  JSON type int → float (old artifacts decode to the wrong type).
+- ``wire-no-default``: ``slice_id`` is NEW and its decoder has no
+  default — the new decoder rejects every artifact written before it.
+- ``wire-golden-stale``: ``hint`` is a benign add-with-default, but the
+  golden was not regenerated — the drift itself is a finding until
+  ``--update-schemas`` moves the golden in the same change.
+"""
+
+_GOLDEN = {
+    "artifact": "bad_telemetry_record",
+    "schema_version": 1,
+    "groups": {
+        "json": {
+            "node": {"type": "str", "required": True},
+            "gpu_uuid": {"type": "str", "required": True},
+            "util": {"type": "int", "required": False},
+        },
+    },
+}
+
+_LIVE = {
+    "artifact": "bad_telemetry_record",
+    "schema_version": 1,
+    "groups": {
+        "json": {
+            "node": {"type": "str", "required": True},
+            "util": {"type": "float", "required": False},
+            "slice_id": {"type": "str", "required": True},
+            "hint": {"type": "str", "required": False},
+        },
+    },
+}
+
+GRAFTCHECK_WIRECOMPAT_AUDIT = [
+    ("bad_telemetry_record", _LIVE, _GOLDEN),
+]

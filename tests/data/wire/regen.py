@@ -1,0 +1,151 @@
+"""Regenerate the committed golden wire ARTIFACTS (not schemas) —
+``python tests/data/wire/regen.py``.
+
+These are serialized bytes from prior-PR wire formats; today's decoders
+must keep loading them (tests/test_wire_compat.py). Unlike the schema
+goldens (tests/data/graftcheck/schemas/, moved by ``--update-schemas``),
+these files should essentially NEVER change: they stand in for
+artifacts already on the wire/disk at upgrade time — a shed snapshot
+mid-flight, a registry heartbeat from an un-upgraded replica, a journal
+checkpoint on a PV. Regenerate only if a format VERSION bump
+deliberately orphans them, and say why in the commit.
+
+- ``snapshot_pre_tiering.npz`` — a real tiny-engine mid-run drain
+  (queue non-empty, slots mid-decode, prefix tree populated), with the
+  PR 16 ``tier_keys`` doc key REMOVED: byte-wise what a pre-tiering
+  engine shipped. ``snapshot_pre_tiering.expect.json`` records the
+  engine config + drained expectations the test asserts field-by-field.
+- ``summary_pr8.json`` — a registry heartbeat with exactly the PR 8
+  field set (no prefill_backlog_tokens/tp/weight_device_bytes/
+  dram_cached_pages; 2-tuple digest entries).
+- ``journal_pr10.json`` — a version-1 journal doc as PR 10 wrote it
+  (stored as the JSON doc; the test wraps it into the uint8 carrier).
+"""
+import dataclasses
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+# Runnable from anywhere: the repo root is three levels up.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(HERE))))
+PAGE = 8
+SEED = 1234
+
+
+def regen_snapshot():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                              decode_attn="dense")
+    params = init_params(cfg, jax.random.PRNGKey(SEED))
+    eng_kw = dict(n_slots=2, max_len=64, chunk=4, prefill_bucket=8,
+                  kv_layout="paged", page_size=PAGE, prefix_cache=True)
+    eng = ContinuousBatcher(params, cfg, **eng_kw)
+    rng = np.random.default_rng(SEED)
+    sys_prompt = [int(t) for t in rng.integers(0, cfg.vocab, 2 * PAGE)]
+    prompts = [sys_prompt + [int(t) for t in rng.integers(0, cfg.vocab, 3 + i)]
+               for i in range(4)]
+    prompts += [[int(t) for t in rng.integers(0, cfg.vocab, 11 + i)]
+                for i in range(2)]
+    ids = [eng.submit(p, max_new=9) for p in prompts]
+    for _ in range(3):      # mid-run: slots decoding, queue still populated,
+        eng.step()          # finished shared-prefix slots donated tree pages
+    snap = eng.drain()
+    assert snap.n_requests_in_flight > 0 and snap.queue and snap.slot_req \
+        and snap.tree_paths, "drain point no longer mid-run — re-probe"
+    tree = dict(snap.to_pytree())
+    doc = json.loads(bytes(np.asarray(tree["meta_json"])).decode())
+    # PR 16 added tier_keys to the doc (default-[] on load). Strip it:
+    # these bytes must be what a PRE-TIERING engine actually wrote.
+    doc.pop("tier_keys")
+    tree["meta_json"] = np.frombuffer(
+        json.dumps(doc).encode(), dtype=np.uint8).copy()
+    np.savez(os.path.join(HERE, "snapshot_pre_tiering.npz"), **tree)
+
+    expect = {
+        "engine_kw": {k: v for k, v in eng_kw.items()},
+        "cfg": {"dtype": "float32", "decode_attn": "dense"},
+        "seed": SEED,
+        "prompts": prompts,
+        "max_new": 9,
+        "request_ids": ids,
+        "fingerprint": snap.fingerprint,
+        "page_ids": [int(p) for p in snap.page_ids],
+        "lens": [int(x) for x in snap.lens],
+        "n_requests_in_flight": snap.n_requests_in_flight,
+        "queue": [[int(r), [int(t) for t in p]] for r, p in snap.queue],
+        "out": {str(r): [int(t) for t in ts] for r, ts in snap.out.items()},
+        "budgets": {str(r): int(b) for r, b in snap.budgets.items()},
+        "n_tree_paths": len(snap.tree_paths),
+        "payload_sha256": __import__("hashlib").sha256(
+            np.ascontiguousarray(snap.k_pages).tobytes()
+            + np.ascontiguousarray(snap.v_pages).tobytes()).hexdigest(),
+    }
+    with open(os.path.join(HERE, "snapshot_pre_tiering.expect.json"),
+              "w") as fh:
+        json.dump(expect, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print("snapshot_pre_tiering.npz:", snap.n_requests_in_flight,
+          "in flight,", len(snap.page_ids), "pages")
+
+
+def regen_summary():
+    # Exactly the PR 8 field set, handwritten — no constructor, so
+    # today's dataclass can never leak new fields into the golden.
+    doc = {
+        "replica": "replica-3",
+        "fleet": "serving",
+        "seq": 17,
+        "published_wall": 1723456789.5,
+        "page_size": 8,
+        "pages_total": 64,
+        "pages_free": 12,
+        "n_slots": 4,
+        "active_slots": 3,
+        "queued": 2,
+        "decode_p50_s": 0.012,
+        "prefill_p50_s": 0.085,
+        "digest": [[[101, 102, 103, 104, 105, 106, 107, 108], 16],
+                   [[201, 202, 203, 204], 8]],
+    }
+    with open(os.path.join(HERE, "summary_pr8.json"), "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print("summary_pr8.json: seq", doc["seq"])
+
+
+def regen_journal():
+    # The version-1 doc exactly as PR 10's router persisted it.
+    doc = {
+        "version": 1,
+        "next_frid": 5,
+        "delivered_tokens_total": 23,
+        "closed": {"done": 2, "error": 0, "expired": 1},
+        "entries": [
+            {"frid": 2, "prompt": [11, 12, 13], "max_new": 8,
+             "trace_id": "trace-2", "replica": "replica-0",
+             "deadline_wall": 1723456800.0, "submitted_wall": 1723456700.0,
+             "delivered": [41, 42, 43], "failovers": 1},
+            {"frid": 4, "prompt": [21, 22], "max_new": 4,
+             "trace_id": None, "replica": None,
+             "deadline_wall": None, "submitted_wall": 1723456710.0,
+             "delivered": [], "failovers": 0},
+        ],
+    }
+    with open(os.path.join(HERE, "journal_pr10.json"), "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print("journal_pr10.json:", len(doc["entries"]), "open entries")
+
+
+if __name__ == "__main__":
+    regen_summary()
+    regen_journal()
+    regen_snapshot()

@@ -1501,6 +1501,273 @@ class TestTraffic:
         assert any("vacuous" in f.message for f in found), found
 
 
+# -- determinism lint (pass 12) -----------------------------------------------
+
+class TestDeterminism:
+    """Per-rule true-positive AND true-negative cells, plus the scope
+    gate — the pass's value is precision: fleet/ must stay clean not
+    because the rules are blind but because the code really is
+    deterministic."""
+
+    def lint(self, src, path="x/fleet/mod.py"):
+        from k8s_gpu_scheduler_tpu.analysis.determinism import (
+            lint_determinism_source,
+        )
+        return lint_determinism_source(path, textwrap.dedent(src))
+
+    def test_unseeded_random_instance(self):
+        found = self.lint("""
+            import random
+            r = random.Random()
+        """)
+        assert rules_of(found) == {"unseeded-rng"}
+
+    def test_seeded_random_instance_clean(self):
+        # The faults.py idiom: crc32-derived per-decision seeds.
+        found = self.lint("""
+            import random
+            import zlib
+            def rng_for(key, run_seed):
+                return random.Random(zlib.crc32(key.encode()) ^ run_seed)
+        """)
+        assert found == []
+
+    def test_module_global_random_fn(self):
+        found = self.lint("""
+            import random
+            def jitter(xs):
+                return random.choice(xs)
+        """)
+        assert rules_of(found) == {"unseeded-rng"}
+
+    def test_numpy_legacy_global_and_unseeded_default_rng(self):
+        found = self.lint("""
+            import numpy as np
+            def a(xs):
+                np.random.shuffle(xs)
+            def b():
+                return np.random.default_rng()
+        """)
+        assert [f.rule for f in found] == ["unseeded-rng", "unseeded-rng"]
+
+    def test_seeded_default_rng_clean(self):
+        found = self.lint("""
+            import numpy as np
+            def mk(seed):
+                return np.random.default_rng(seed)
+        """)
+        assert found == []
+
+    def test_builtin_hash(self):
+        found = self.lint("""
+            def route(prompt, n):
+                return hash(tuple(prompt)) % n
+        """)
+        assert rules_of(found) == {"builtin-hash"}
+
+    def test_crc32_clean(self):
+        found = self.lint("""
+            import zlib
+            def route(blob, n):
+                return zlib.crc32(blob) % n
+        """)
+        assert found == []
+
+    def test_unordered_iteration_append_and_first_match(self):
+        found = self.lint("""
+            class Picker:
+                def __init__(self):
+                    self._members = {"a", "b"}
+                def victims(self, n):
+                    out = []
+                    for m in self._members:
+                        out.append(m)
+                        if len(out) == n:
+                            break
+                    return out
+                def first_live(self, dead):
+                    for m in self._members - dead:
+                        return m
+        """)
+        assert [f.rule for f in found] == ["unordered-iteration"] * 2
+
+    def test_sorted_iteration_clean(self):
+        found = self.lint("""
+            class Picker:
+                def __init__(self):
+                    self._members = {"a", "b"}
+                def victims(self):
+                    out = []
+                    for m in sorted(self._members):
+                        out.append(m)
+                    return out
+        """)
+        assert found == []
+
+    def test_membership_check_loop_clean(self):
+        # A loop that only validates (raise — no ordered sink) is fine:
+        # the paging.py assert_consistent shape.
+        found = self.lint("""
+            def check(dram, disk, nxt):
+                for k in dram | disk:
+                    if k >= nxt:
+                        raise ValueError(k)
+        """)
+        assert found == []
+
+    def test_wall_clock_decision(self):
+        found = self.lint("""
+            import time
+            def expired(deadline):
+                return time.time() > deadline
+        """)
+        assert rules_of(found) == {"wall-clock-decision"}
+
+    def test_injected_clock_clean(self):
+        found = self.lint("""
+            def expired(clock, deadline):
+                return clock.wall() > deadline
+        """)
+        assert found == []
+
+    def test_out_of_scope_file_ignored(self):
+        from k8s_gpu_scheduler_tpu.analysis.determinism import (
+            lint_determinism_source,
+        )
+        src = "import random\nr = random.Random()\n"
+        assert lint_determinism_source("x/bench_helpers.py", src) == []
+        # …until it opts in with the fixture marker.
+        marked = "GRAFTCHECK_DETERMINISM_LINT = True\n" + src
+        assert rules_of(lint_determinism_source(
+            "x/bench_helpers.py", marked)) == {"unseeded-rng"}
+
+    def test_suppression_with_rationale_honored(self):
+        found = self.lint("""
+            import random
+            # demo-only path, never replayed — graftcheck: ignore[unseeded-rng]
+            r = random.Random()
+        """)
+        assert found == []
+
+    def test_fixture_trips_all_four_rules(self):
+        from k8s_gpu_scheduler_tpu.analysis.determinism import (
+            lint_determinism_source,
+        )
+        with open(os.path.join(FIXTURES, "bad_determinism.py")) as fh:
+            src = fh.read()
+        assert rules_of(lint_determinism_source(
+            os.path.join(FIXTURES, "bad_determinism.py"), src)) == {
+                "unseeded-rng", "builtin-hash", "unordered-iteration",
+                "wall-clock-decision"}
+
+    def test_rides_fast_passes_with_timing(self):
+        report = run_fast_passes([os.path.join(FIXTURES,
+                                               "bad_determinism.py")])
+        assert "determinism" in report.pass_seconds
+        assert {"unseeded-rng", "builtin-hash", "unordered-iteration",
+                "wall-clock-decision"} <= rules_of(report.findings)
+
+
+# -- wire-format schema audit (pass 11) ---------------------------------------
+
+class TestWirecompat:
+    """Diff-rule cells against synthetic schemas (the golden-vs-live
+    mechanics; the real registry's clean diff and the per-artifact
+    decode fidelity live in tests/test_wire_compat.py)."""
+
+    GOLDEN = {
+        "artifact": "toy", "schema_version": 1,
+        "groups": {"json": {
+            "a": {"type": "str", "required": True},
+            "b": {"type": "int", "required": False},
+        }},
+    }
+
+    def diff(self, live):
+        from k8s_gpu_scheduler_tpu.analysis.wirecompat import diff_schemas
+        return diff_schemas("toy", live, self.GOLDEN)
+
+    def test_identical_schemas_clean(self):
+        import copy
+        assert self.diff(copy.deepcopy(self.GOLDEN)) == []
+
+    def test_missing_golden_is_stale(self):
+        from k8s_gpu_scheduler_tpu.analysis.wirecompat import diff_schemas
+        found = diff_schemas("toy", self.GOLDEN, None)
+        assert rules_of(found) == {"wire-golden-stale"}
+        assert "--update-schemas" in found[0].message
+
+    def test_removed_field_is_wire_break(self):
+        live = {"artifact": "toy", "schema_version": 1,
+                "groups": {"json": {
+                    "a": {"type": "str", "required": True}}}}
+        assert "wire-break" in rules_of(self.diff(live))
+
+    def test_type_change_is_wire_break(self):
+        import copy
+        live = copy.deepcopy(self.GOLDEN)
+        live["groups"]["json"]["b"]["type"] = "float"
+        found = self.diff(live)
+        assert "wire-break" in rules_of(found)
+        assert any("int -> float" in f.message for f in found)
+
+    def test_new_required_field_is_wire_no_default(self):
+        import copy
+        live = copy.deepcopy(self.GOLDEN)
+        live["groups"]["json"]["c"] = {"type": "str", "required": True}
+        assert "wire-no-default" in rules_of(self.diff(live))
+
+    def test_benign_add_with_default_is_only_stale(self):
+        import copy
+        live = copy.deepcopy(self.GOLDEN)
+        live["groups"]["json"]["c"] = {"type": "str", "required": False}
+        assert rules_of(self.diff(live)) == {"wire-golden-stale"}
+
+    def test_requiredness_probe_uses_real_decoder(self):
+        """The probe literally deletes a field and runs from_json: the
+        only required ReplicaSummary field is the one with no dataclass
+        default."""
+        from k8s_gpu_scheduler_tpu.analysis.wirecompat import (
+            extract_schemas,
+        )
+        fields = extract_schemas()["replica_summary"]["groups"]["json"]
+        required = {k for k, v in fields.items() if v["required"]}
+        assert required == {"replica"}
+
+    def test_update_flag_then_clean(self, tmp_path):
+        """--update-schemas writes goldens the next run diffs clean, and
+        a second update is byte-identical (the CI no-op pin)."""
+        from k8s_gpu_scheduler_tpu.analysis import run_wirecompat_pass
+        rep = run_wirecompat_pass(paths=[], schema_dir=str(tmp_path),
+                                  update=True)
+        assert rep.errors == []
+        first = {p.name: p.read_bytes() for p in tmp_path.iterdir()}
+        assert set(first) == {"serving_snapshot.json",
+                              "replica_summary.json",
+                              "request_journal.json"}
+        rep = run_wirecompat_pass(paths=[], schema_dir=str(tmp_path))
+        assert rep.findings == []
+        run_wirecompat_pass(paths=[], schema_dir=str(tmp_path),
+                            update=True)
+        assert {p.name: p.read_bytes()
+                for p in tmp_path.iterdir()} == first
+
+    def test_hook_entries_and_hook_error(self, tmp_path):
+        """The seeded-fixture protocol: a GRAFTCHECK_WIRECOMPAT_AUDIT
+        hook's drifted schema fails the pass, and a malformed entry
+        surfaces as hook-error instead of crashing the run."""
+        from k8s_gpu_scheduler_tpu.analysis import run_wirecompat_pass
+        rep = run_wirecompat_pass(
+            paths=[os.path.join(FIXTURES, "bad_wirecompat.py")])
+        assert {"wire-break", "wire-no-default",
+                "wire-golden-stale"} <= rules_of(rep.findings)
+        assert "wirecompat" in rep.pass_seconds
+        bad = tmp_path / "bad_hook.py"
+        bad.write_text("GRAFTCHECK_WIRECOMPAT_AUDIT = [('only-name',)]\n")
+        rep = run_wirecompat_pass(paths=[str(bad)])
+        assert "hook-error" in rules_of(rep.findings)
+
+
 # -- CLI contract -------------------------------------------------------------
 
 def run_cli(*extra, fast=True):
@@ -1527,6 +1794,7 @@ class TestCli:
         "bad_astlint.py",
         *(pytest.param(f, marks=pytest.mark.slow)
           for f in ("bad_retry.py", "bad_trace.py", "bad_lockorder.py",
+                    "bad_determinism.py",
                     "bad_vmem.py", "bad_vmem_paged.py",
                     "bad_vmem_verify.py", "bad_vmem_prefill.py")),
     ])
@@ -1564,7 +1832,7 @@ class TestCli:
     # fixture test above keeps per-family CLI signal in tier-1, and the
     # unfiltered CI suite runs this end-to-end check.
     def test_full_cli_catches_all_fixture_families(self):
-        """The acceptance criterion end-to-end: the DEFAULT ten-pass
+        """The acceptance criterion end-to-end: the DEFAULT twelve-pass
         CLI exits non-zero with file:line findings when the seeded bad
         fixtures are in the scanned paths (one subprocess run for every
         family — the traced passes dominate its wall time)."""
@@ -1581,4 +1849,9 @@ class TestCli:
                 "bare-suppression",
                 # pass 9 (bad_traffic.py hook entries)
                 "dense-materialization", "peak-residency",
-                "traffic-contract"} <= set(summary["rules"])
+                "traffic-contract",
+                # pass 11 (bad_wirecompat.py hook entries)
+                "wire-break", "wire-no-default", "wire-golden-stale",
+                # pass 12 (bad_determinism.py, opt-in marker)
+                "unseeded-rng", "builtin-hash", "unordered-iteration",
+                "wall-clock-decision"} <= set(summary["rules"])

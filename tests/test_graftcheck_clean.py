@@ -1,12 +1,15 @@
 """Tier-1 gate: the tree must stay graftcheck-clean.
 
 Runs the FAST passes (AST lint incl. retry/trace/suppression lints, the
-lock-order & donated-buffer audit, VMEM budgeter — no tracing, ~4 s)
-over the package exactly as ``make lint`` does, and fails with the
-rendered ``file:line: [rule] message`` list if anything regressed. The
-traced passes (jaxpr audit, recompile guard, alias, gspmd, symbolic
-traffic) have their own tests in tests/test_analysis.py; the full
-ten-pass run is ``python -m k8s_gpu_scheduler_tpu.analysis``.
+lock-order & donated-buffer audit, the determinism lint over the
+replay/placement planes [unseeded-rng / builtin-hash /
+unordered-iteration / wall-clock-decision], VMEM budgeter — no tracing,
+~4 s) over the package exactly as ``make lint`` does, and fails with
+the rendered ``file:line: [rule] message`` list if anything regressed.
+The traced passes (jaxpr audit, recompile guard, alias, gspmd, symbolic
+traffic) and the wire-format schema audit have their own tests in
+tests/test_analysis.py + tests/test_wire_compat.py; the full
+twelve-pass run is ``python -m k8s_gpu_scheduler_tpu.analysis``.
 
 Suppression policy: ``# graftcheck: ignore[rule]`` with a rationale in
 the surrounding comment (see README "graftcheck").

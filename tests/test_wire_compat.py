@@ -1,0 +1,224 @@
+"""Golden wire ARTIFACTS from prior-PR formats still load (tier-1).
+
+tests/data/wire/ holds serialized bytes as older PRs wrote them — a
+pre-tiering engine's mid-run drain (no ``tier_keys`` in the meta doc),
+a PR 8 registry heartbeat (no backlog/tp/weight/dram fields, 2-tuple
+digest), a PR 10 journal doc — and this suite proves TODAY's decoders
+load all three token/byte-faithfully. This turns the scattered
+back-compat pins (the payload_shape default, the tier sidecar default,
+the default-0 summary fields) into one fixture-driven contract: break
+any decoder default and a committed artifact stops loading right here,
+before graftcheck pass 11 (``wirecompat``) even diffs the schemas.
+
+The last test closes the loop with the pass itself: a deliberately
+field-dropped live schema must trip ``wire-break`` against the
+committed golden — the audit is what turns "we remembered a default"
+into "removal cannot land without a golden bump".
+
+Regeneration policy: tests/data/wire/regen.py — these artifacts stand
+in for bytes already on the wire at upgrade time and should essentially
+never change (unlike the schema goldens, which ``--update-schemas``
+moves whenever the format evolves deliberately).
+"""
+import copy
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_scheduler_tpu.analysis.wirecompat import (
+    diff_schemas, extract_schemas, load_golden,
+)
+from k8s_gpu_scheduler_tpu.fleet.journal import RequestJournal
+from k8s_gpu_scheduler_tpu.fleet.summary import (
+    ReplicaSummary, prefix_match_parts,
+)
+from k8s_gpu_scheduler_tpu.models.snapshot import ServingSnapshot
+
+WIRE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "data", "wire")
+
+
+def load_snapshot_tree():
+    with np.load(os.path.join(WIRE, "snapshot_pre_tiering.npz")) as z:
+        return {k: z[k] for k in z.files}
+
+
+def load_expect():
+    with open(os.path.join(WIRE, "snapshot_pre_tiering.expect.json")) as fh:
+        return json.load(fh)
+
+
+class TestPreTieringSnapshot:
+    def test_doc_is_really_pre_tiering(self):
+        """The fixture's meta doc must NOT carry ``tier_keys`` — if a
+        regen accidentally writes today's format, this suite would be
+        vacuously green."""
+        tree = load_snapshot_tree()
+        doc = json.loads(bytes(np.asarray(tree["meta_json"])).decode())
+        assert "tier_keys" not in doc
+        assert doc["version"] == 1
+
+    def test_loads_byte_faithfully(self):
+        """Every field decodes to the recorded drain-time value; the page
+        payload is byte-identical (sha256); the PR 16 tier sidecar
+        defaults to empty."""
+        import hashlib
+
+        snap = ServingSnapshot.from_pytree(load_snapshot_tree())
+        exp = load_expect()
+        assert snap.fingerprint == exp["fingerprint"]
+        assert [int(p) for p in snap.page_ids] == exp["page_ids"]
+        assert [int(x) for x in snap.lens] == exp["lens"]
+        assert snap.n_requests_in_flight == exp["n_requests_in_flight"]
+        assert [[r, p] for r, p in snap.queue] == exp["queue"]
+        assert {str(r): ts for r, ts in snap.out.items()} == exp["out"]
+        assert {str(r): b for r, b in snap.budgets.items()} == exp["budgets"]
+        assert len(snap.tree_paths) == exp["n_tree_paths"]
+        payload = hashlib.sha256(
+            np.ascontiguousarray(snap.k_pages).tobytes()
+            + np.ascontiguousarray(snap.v_pages).tobytes()).hexdigest()
+        assert payload == exp["payload_sha256"]
+        # Fields the doc never carried take their decoder defaults.
+        assert snap.tier_keys == [] and snap.tier_k is None
+        assert snap.partial is False
+
+    def test_restores_into_live_engine_token_faithfully(self):
+        """The real upgrade path: today's engine absorbs the pre-tiering
+        drain — fingerprint accepted, every interrupted request resumes,
+        and each finished stream STARTS WITH the tokens the drained
+        engine had already emitted (the journal/replay invariant: bytes
+        a client was sent must survive the format boundary)."""
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        exp = load_expect()
+        cfg = dataclasses.replace(
+            LlamaConfig.tiny(), dtype=jnp.float32,
+            decode_attn=exp["cfg"]["decode_attn"])
+        params = init_params(cfg, jax.random.PRNGKey(exp["seed"]))
+        eng = ContinuousBatcher(params, cfg, **exp["engine_kw"])
+        snap = ServingSnapshot.from_pytree(load_snapshot_tree())
+        resumed = eng.restore(snap)
+        assert resumed == exp["n_requests_in_flight"]
+        done = {}
+        while eng.pending:
+            done.update(eng.step())
+        expected_rids = {int(r) for r in exp["out"]} \
+            | {r for r, _ in exp["queue"]}
+        assert expected_rids <= set(done)
+        for r, emitted in exp["out"].items():
+            assert done[int(r)][:len(emitted)] == emitted
+
+    def test_max_new_respected_after_restore(self):
+        """Budgets survive the boundary: no stream exceeds the recorded
+        remaining budget + already-emitted tokens."""
+        exp = load_expect()
+        snap = ServingSnapshot.from_pytree(load_snapshot_tree())
+        for r, b in snap.budgets.items():
+            emitted = len(snap.out.get(r, []))
+            assert emitted + b <= exp["max_new"]
+
+
+class TestPr8Summary:
+    def test_loads_with_defaults(self):
+        with open(os.path.join(WIRE, "summary_pr8.json")) as fh:
+            raw = fh.read()
+        d = json.loads(raw)
+        # The fixture must really be the PR 8 field set.
+        assert "tp" not in d and "prefill_backlog_tokens" not in d
+        s = ReplicaSummary.from_json(raw)
+        assert (s.replica, s.fleet, s.seq) == ("replica-3", "serving", 17)
+        assert (s.pages_total, s.pages_free, s.active_slots, s.queued) \
+            == (64, 12, 3, 2)
+        # Post-PR-8 fields take their documented defaults.
+        assert s.prefill_backlog_tokens == 0 and s.tp == 1
+        assert s.weight_device_bytes == 0 and s.dram_cached_pages == 0
+        assert s.digest == [([101, 102, 103, 104, 105, 106, 107, 108], 16),
+                            ([201, 202, 203, 204], 8)]
+
+    def test_two_tuple_digest_scores_fully_resident(self):
+        """A pre-tiering digest entry (2-tuple) must keep scoring as
+        fully resident — the router's demoted-match discount never
+        penalizes an un-upgraded replica."""
+        with open(os.path.join(WIRE, "summary_pr8.json")) as fh:
+            s = ReplicaSummary.from_json(fh.read())
+        prompt = [101, 102, 103, 104, 105, 106, 107, 108, 9, 9, 9]
+        match, resident = prefix_match_parts(prompt, s.digest, s.page_size)
+        assert match == 8 and resident == 8
+
+
+class TestPr10Journal:
+    def _tree(self):
+        with open(os.path.join(WIRE, "journal_pr10.json")) as fh:
+            doc = json.load(fh)
+        raw = json.dumps(doc, sort_keys=True).encode()
+        return {"journal_doc": np.frombuffer(raw, np.uint8).copy()}
+
+    def test_loads_faithfully(self):
+        j = RequestJournal.from_pytree(self._tree())
+        assert len(j) == 2 and j.open_frids() == [2, 4]
+        assert j.delivered_tokens_total == 23
+        assert j.closed == {"done": 2, "error": 0, "expired": 1}
+        e = j.entry(2)
+        assert e.delivered == [41, 42, 43] and e.failovers == 1
+        assert e.remaining == 5 and e.replica == "replica-0"
+        # The orphan (replica None) is exactly what failover replays.
+        assert [o.frid for o in j.inflight_on(None)] == [4]
+
+    def test_round_trips_through_todays_encoder(self):
+        j = RequestJournal.from_pytree(self._tree())
+        j2 = RequestJournal.from_pytree(j.to_pytree())
+        assert j2.open_frids() == j.open_frids()
+        assert j2.stream(2) == j.stream(2)
+
+
+class TestWireBreakTripsAudit:
+    """The acceptance-criterion loop: drop a field from the live schema
+    and the committed golden must trip ``wire-break`` — for a JSON field
+    and for a pytree leaf."""
+
+    @pytest.fixture(scope="class")
+    def live(self):
+        schemas = extract_schemas()
+        assert set(schemas) == {"serving_snapshot", "replica_summary",
+                                "request_journal"}
+        return schemas
+
+    def test_clean_schemas_match_committed_goldens(self, live):
+        for name, schema in live.items():
+            assert diff_schemas(name, schema, load_golden(name)) == []
+
+    def test_dropped_summary_field_trips_wire_break(self, live):
+        broken = copy.deepcopy(live["replica_summary"])
+        del broken["groups"]["json"]["pages_free"]
+        rules = {f.rule for f in diff_schemas(
+            "replica_summary", broken, load_golden("replica_summary"))}
+        assert "wire-break" in rules and "wire-golden-stale" in rules
+
+    def test_dropped_snapshot_leaf_trips_wire_break(self, live):
+        broken = copy.deepcopy(live["serving_snapshot"])
+        del broken["groups"]["pytree"]["meta_json"]
+        rules = {f.rule for f in diff_schemas(
+            "serving_snapshot", broken, load_golden("serving_snapshot"))}
+        assert "wire-break" in rules
+
+    def test_new_no_default_field_trips_wire_no_default(self, live):
+        broken = copy.deepcopy(live["request_journal"])
+        broken["groups"]["entry"]["tenant"] = {"type": "str",
+                                               "required": True}
+        rules = {f.rule for f in diff_schemas(
+            "request_journal", broken, load_golden("request_journal"))}
+        assert "wire-no-default" in rules
+        # The benign variant only goes stale — add-with-default is the
+        # sanctioned evolution path.
+        benign = copy.deepcopy(live["request_journal"])
+        benign["groups"]["entry"]["tenant"] = {"type": "str",
+                                               "required": False}
+        rules = {f.rule for f in diff_schemas(
+            "request_journal", benign, load_golden("request_journal"))}
+        assert rules == {"wire-golden-stale"}
