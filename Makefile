@@ -53,6 +53,7 @@ bench-smoke:
 	$(PY) bench.py --leg fleet --smoke
 	$(PY) bench.py --leg fleet_chaos --smoke
 	$(PY) bench.py --leg chunked_prefill --smoke
+	$(PY) bench.py --leg disagg --smoke
 	$(PY) bench.py --leg sharded_decode --smoke
 	$(PY) bench.py --leg sharded_weights --smoke
 	$(PY) bench.py --leg multiturn --smoke

@@ -2087,6 +2087,332 @@ def bench_chunked_prefill(smoke=False):
     }
 
 
+def bench_disagg(smoke=False):
+    """Disaggregated-serving leg — the phase-isolation contract of
+    ``Router(pools=...)`` (fleet/router.py + fleet/pools.py), measured:
+    the chunked-prefill leg's decode-heavy Poisson trace with injected
+    long prompts runs over a COLOCATED fleet (4 mixed replicas, each
+    admitting + decoding) and a DISAGGREGATED fleet (2 role='prefill'
+    replicas + 2 decode replicas, drain→absorb handoff at the phase
+    boundary) on the SAME step-indexed schedule, round-robin placement
+    both so the comparison is pure pool structure. Because the
+    in-process router serializes every replica onto one thread, walls
+    are ENGINE-LOCAL (each replica's own step() wall — what concurrent
+    replicas would each observe): the decode-step stall series is each
+    decode-capable engine's per-step wall, and TPOT is per-request
+    decode elapsed on the OWNING engine's clock / tokens it decoded
+    there. Colocated, a long admission's whole prefill lands inside a
+    decode engine's step and every co-resident stream eats it; disagg,
+    the decode pool never dispatches prefill at all, so its stall
+    ceiling is one decode chunk. The CI step asserts byte-identical
+    streams vs a single-engine reference, zero retrace on both pools
+    across the measured passes, requests_lost == 0, every request
+    handed off, STRICTLY lower max decode-step stall and TPOT p99 for
+    disagg, and a valid Perfetto export carrying the full
+    prefill_chunk → handoff → decode_chunk lifecycle under one rid. On
+    CPU (or --smoke) the model is tiny/f32; the TPU run under the
+    driver is what BENCH_*.json captures."""
+    import dataclasses
+    import os
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_scheduler_tpu.analysis.recompile import RecompileGuard
+    from k8s_gpu_scheduler_tpu.fleet import Router
+    from k8s_gpu_scheduler_tpu.metrics.exporter import Registry
+    from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+    from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+    from k8s_gpu_scheduler_tpu.obs import (
+        Tracer, validate_perfetto, write_perfetto,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if smoke or not on_tpu:
+        # f32: the identity assert must see no bf16 near-tie noise.
+        cfg = dataclasses.replace(LlamaConfig(
+            vocab=256, d_model=64, n_layers=2, n_heads=8, n_kv_heads=8,
+            d_ff=128, max_seq=512, remat=False), dtype=jnp.float32)
+        n_short, short_p, short_new, rate = 14, 12, 40, 1.0
+        long_p, long_new, long_at = 256, 8, (6, 14)
+        chunk_budget = 32
+        eng_kw = dict(n_slots=6, max_len=320, chunk=4, prefill_bucket=16,
+                      kv_layout="paged", page_size=16, prefix_cache=False)
+    else:
+        cfg = LlamaConfig(
+            vocab=32000, d_model=1024, n_layers=4, n_heads=16,
+            n_kv_heads=16, d_ff=4096, max_seq=2048, remat=False,
+            decode_attn="fused")
+        n_short, short_p, short_new, rate = 38, 64, 64, 2.0
+        long_p, long_new, long_at = 1024, 16, (8, 20)
+        chunk_budget = 256
+        eng_kw = dict(n_slots=8, max_len=2048, chunk=8,
+                      prefill_bucket=128, kv_layout="paged", page_size=64,
+                      kv_dtype="int8", prefix_cache=False)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    # One step-indexed schedule for BOTH fleets (the chunked-prefill
+    # leg's interference trace): shorts Poisson at ``rate``/step, longs
+    # injected while shorts decode, a burst of shorts with each long.
+    # The total submission count is a multiple of every pool width so
+    # the round-robin cursor returns to zero each pass and placement —
+    # hence every compiled rung — is identical across the warm and
+    # measured passes.
+    arr = np.floor(np.cumsum(
+        rng.exponential(1.0 / rate, n_short))).astype(int)
+    sched = [(int(s), list(rng.integers(0, cfg.vocab, short_p)),
+              short_new, "short") for s in arr]
+    for ls in long_at:
+        sched.append((ls, list(rng.integers(0, cfg.vocab, long_p)),
+                      long_new, "long"))
+        for burst_step in (ls, ls + 1):
+            for _ in range(2):
+                sched.append((burst_step,
+                              list(rng.integers(0, cfg.vocab, short_p)),
+                              short_new, "short"))
+    sched.sort(key=lambda e: e[0])
+    n_req = len(sched)
+    assert n_req % 4 == 0, "trace must divide every rr pool width"
+
+    # Single-engine reference: greedy streams do not depend on which
+    # pool decodes them — one mixed engine's answers are the truth for
+    # both fleets (and for the handoff itself).
+    ref_eng = ContinuousBatcher(params, cfg, **eng_kw)
+    ref_ids = [ref_eng.submit(p, max_new=mn) for _, p, mn, _ in sched]
+    ref_done = {}
+    while ref_eng.pending:
+        ref_done.update(ref_eng.step())
+    ref = [ref_done[i] for i in ref_ids]
+
+    def instrument(eng, stalls, vwall, rid):
+        """Wrap ``eng.step`` with the engine-local clocks: per-step wall
+        appended to ``stalls[rid]`` when the step ran a decode/verify
+        dispatch, and accumulated into ``vwall[rid]`` always — the
+        virtual own-thread clock a concurrently-deployed replica would
+        read (the in-process router serializes replicas, so host wall
+        across a router step charges every replica for its peers)."""
+        orig = eng.step
+
+        def step():
+            seq0 = eng._flight._seq
+            t0 = time.perf_counter()
+            out = orig()
+            wall = time.perf_counter() - t0
+            vwall[rid] += wall
+            if any(r["seq"] >= seq0
+                   and r["kind"] in ("decode", "verify")
+                   for r in eng._flight.records()):
+                stalls[rid].append(wall * 1e3)
+            return out
+
+        eng.step = step
+
+    def build(mode):
+        tr = Tracer(capacity=1 << 16)
+        reg = Registry()
+        stalls, vwall = {}, {}
+        if mode == "disagg":
+            reps = (
+                [(f"p{i}", ContinuousBatcher(
+                    params, cfg, role="prefill",
+                    prefill_chunk_tokens=chunk_budget, tracer=tr,
+                    **eng_kw)) for i in range(2)]
+                + [(f"d{i}", ContinuousBatcher(
+                    params, cfg, role="decode", tracer=tr, **eng_kw))
+                   for i in range(2)])
+            pools = {"prefill": ["p0", "p1"], "decode": ["d0", "d1"]}
+            measured = ("d0", "d1")
+        else:
+            reps = [(f"m{i}", ContinuousBatcher(params, cfg, tracer=tr,
+                                                **eng_kw))
+                    for i in range(4)]
+            pools, measured = None, ("m0", "m1", "m2", "m3")
+        for rid, eng in reps:
+            stalls[rid], vwall[rid] = [], 0.0
+            instrument(eng, stalls, vwall, rid)
+        router = Router(reps, pools=pools, policy="round_robin",
+                        tracer=tr, metrics=reg)
+        guards = {}
+        for rid, eng in reps:
+            g = RecompileGuard()
+            g.track("decode", eng._decode)
+            g.track("prefill", eng._prefill)
+            guards[rid] = g
+        return {"router": router, "tracer": tr, "reg": reg,
+                "stalls": stalls, "vwall": vwall, "guards": guards,
+                "measured": measured}
+
+    def drive(fl):
+        """One pass of the trace through ``fl``; engine-local stall
+        series reset per pass, TPOT computed on each request's OWNING
+        engine's virtual clock (the decode replica after a handoff)."""
+        rtr, stalls, vwall = fl["router"], fl["stalls"], fl["vwall"]
+        for s in stalls.values():
+            s.clear()
+        frids, done, tpot = [], {}, {}
+        track, last_owner, owner_at = {}, {}, {}
+        plan_peak, plan_scale_up = 0, False
+        nxt, t = 0, 0
+        t0 = time.perf_counter()
+        while nxt < n_req or rtr.pending:
+            while nxt < n_req and sched[nxt][0] <= t:
+                frids.append(rtr.submit(sched[nxt][1],
+                                        max_new=sched[nxt][2],
+                                        trace_id=f"rq{nxt:03d}"))
+                nxt += 1
+            new = rtr.step()
+            for frid, toks in new.items():
+                o = last_owner.get(frid)
+                v0, n0 = track.get(frid, {}).get(o, (None, None))
+                if v0 is not None and len(toks) - n0 >= 4:
+                    tpot[frid] = ((vwall[o] - v0) / (len(toks) - n0)
+                                  * 1e3)
+            done.update(new)
+            for frid in frids:
+                if frid in done:
+                    continue
+                loc = rtr._where.get(frid)
+                if loc is None:
+                    continue
+                owner = loc[0]
+                ntok = len(rtr.journal.stream(frid))
+                if ntok >= 1 and owner not in track.setdefault(frid, {}):
+                    track[frid][owner] = (vwall[owner], ntok)
+                last_owner[frid] = owner
+            if rtr._pools is not None:
+                plan = rtr.pool_plan()
+                plan_peak = max(plan_peak,
+                                plan.prefill_replicas_desired)
+                plan_scale_up = plan_scale_up or plan.decode_scale_up
+            t += 1
+        wall = time.perf_counter() - t0
+        streams = [done[f] for f in frids]
+        met = rtr.pop_request_metrics()
+        ttft = [met[f]["ttft_s"] * 1e3 for f in frids if f in met]
+        pool_stalls = [w for rid in fl["measured"]
+                       for w in stalls[rid]]
+        return {
+            "streams": streams,
+            "max_stall": max(pool_stalls),
+            "stall_p99": _pctl(pool_stalls, 0.99),
+            "tpot_p99": _pctl(list(tpot.values()), 0.99),
+            "tpot_p50": _pctl(list(tpot.values()), 0.50),
+            "ttft_p99": _pctl(ttft, 0.99),
+            "wall": wall,
+            "plan_peak": plan_peak,
+            "plan_scale_up": plan_scale_up,
+        }
+
+    fleets = {m: build(m) for m in ("colocated", "disagg")}
+    for m in fleets:
+        drive(fleets[m])             # warm pass: every rung compiles
+        for g in fleets[m]["guards"].values():
+            g.snapshot()
+    # Interleaved best-of-N measured passes (the chunked-prefill-leg
+    # pattern): machine drift hits both fleets alike; min() per fleet
+    # takes each one's clean floor.
+    repeats = 2
+    passes = {m: [] for m in fleets}
+    for _ in range(repeats):
+        for m in ("colocated", "disagg"):
+            passes[m].append(drive(fleets[m]))
+
+    def agg(mode):
+        ps = passes[mode]
+        misses = {rid: g.misses_since()
+                  for rid, g in fleets[mode]["guards"].items()}
+        st = fleets[mode]["router"].stats()
+        return {
+            "streams": ps[0]["streams"],
+            "same_streams": all(p["streams"] == ps[0]["streams"]
+                                for p in ps),
+            "max_stall": min(p["max_stall"] for p in ps),
+            "stall_p99": min(p["stall_p99"] for p in ps),
+            "tpot_p99": min(p["tpot_p99"] for p in ps),
+            "tpot_p50": min(p["tpot_p50"] for p in ps),
+            "ttft_p99": min(p["ttft_p99"] for p in ps),
+            "retraces": sum(n for m_ in misses.values()
+                            for n in m_.values()),
+            "lost": st["requests_lost"],
+            "handoffs": st["handoffs"],
+            "plan_peak": max(p["plan_peak"] for p in ps),
+            "plan_scale_up": any(p["plan_scale_up"] for p in ps),
+        }
+
+    dis, col = agg("disagg"), agg("colocated")
+    # Perfetto artifact from the disagg run: the handed-off request's
+    # prefill_chunk (prefill replica) → handoff (router lane) →
+    # decode_chunk (decode replica) phases correlate under ONE rid via
+    # the trace_id relabel absorb applies.
+    spans = fleets["disagg"]["tracer"].spans()
+    by_rid = {}
+    for s in spans:
+        if s.rid is not None:
+            by_rid.setdefault(s.rid, set()).add(s.name)
+    lifecycle = {"prefill_chunk", "handoff", "decode_chunk"}
+    phases_ok = any(lifecycle <= names for names in by_rid.values())
+    perfetto_path = os.path.join(tempfile.gettempdir(),
+                                 "disagg_trace_perfetto.json")
+    doc = write_perfetto(spans, perfetto_path)
+    problems = validate_perfetto(doc)
+    handoff_ms = [(s.t1 - s.t0) * 1e3 for s in spans
+                  if s.name == "handoff"]
+
+    extra = {
+        "disagg_shape": (
+            f"{n_req - len(long_at)} shorts ({short_p} tok, max_new "
+            f"{short_new}) at {rate}/step + {len(long_at)} x "
+            f"{long_p}-tok longs; 2 prefill (chunk {chunk_budget}) + "
+            f"2 decode vs 4 mixed"),
+        "disagg_interpret": not on_tpu,
+        "disagg_passes": repeats,
+        "disagg_token_identity": (dis["streams"] == ref
+                                  and col["streams"] == ref
+                                  and dis["same_streams"]
+                                  and col["same_streams"]),
+        "disagg_zero_retrace": dis["retraces"] == 0,
+        "colocated_retraces": col["retraces"],
+        "disagg_requests_lost": dis["lost"],
+        "colocated_requests_lost": col["lost"],
+        # warm + measured passes all hand every request off exactly once
+        "disagg_handoffs_total": dis["handoffs"],
+        "disagg_all_handed_off": (
+            dis["handoffs"] == (repeats + 1) * n_req),
+        "colocated_max_stall_ms": round(col["max_stall"], 1),
+        "disagg_max_stall_ms": round(dis["max_stall"], 1),
+        "colocated_stall_p99_ms": round(col["stall_p99"], 1),
+        "disagg_stall_p99_ms": round(dis["stall_p99"], 1),
+        "colocated_tpot_p99_ms": round(col["tpot_p99"], 2),
+        "disagg_tpot_p99_ms": round(dis["tpot_p99"], 2),
+        "colocated_tpot_p50_ms": round(col["tpot_p50"], 2),
+        "disagg_tpot_p50_ms": round(dis["tpot_p50"], 2),
+        "colocated_ttft_p99_ms": round(col["ttft_p99"], 1),
+        "disagg_ttft_p99_ms": round(dis["ttft_p99"], 1),
+        "disagg_handoff_p50_ms": round(_pctl(handoff_ms, 0.50), 2),
+        "disagg_handoff_p99_ms": round(_pctl(handoff_ms, 0.99), 2),
+        "disagg_plan_prefill_desired_peak": dis["plan_peak"],
+        "disagg_plan_decode_scale_up": dis["plan_scale_up"],
+        "disagg_perfetto_valid": not problems and phases_ok,
+        "disagg_perfetto_path": perfetto_path,
+        "disagg_perfetto_spans": len(spans),
+    }
+    extra["disagg_stall_win"] = (extra["disagg_max_stall_ms"]
+                                 < extra["colocated_max_stall_ms"])
+    extra["disagg_tpot_win"] = (extra["disagg_tpot_p99_ms"]
+                                < extra["colocated_tpot_p99_ms"])
+    stall_ratio = (extra["colocated_max_stall_ms"]
+                   / max(extra["disagg_max_stall_ms"], 1e-9))
+    return {
+        "metric": "disagg_stall_ratio",
+        "value": round(stall_ratio, 2),
+        "unit": "x",
+        "extra": extra,
+    }
+
+
 def bench_multiturn(smoke=False):
     """Multi-turn serving leg — the prefix-attention prefill kernel +
     decoded-suffix caching, measured end-to-end: N conversations × K
@@ -2690,6 +3016,9 @@ def main(argv=None):
         if leg == "chunked_prefill":
             print(json.dumps(bench_chunked_prefill(smoke="--smoke" in args)))
             return
+        if leg == "disagg":
+            print(json.dumps(bench_disagg(smoke="--smoke" in args)))
+            return
         if leg == "sharded_decode":
             print(json.dumps(bench_sharded_decode(smoke="--smoke" in args)))
             return
@@ -2705,7 +3034,7 @@ def main(argv=None):
         raise SystemExit(f"unknown bench leg: {leg!r} (available: "
                          f"decode_attention, paged_attention, prefix_cache, "
                          f"speculative, analysis, chaos, obs_overhead, "
-                         f"fleet, fleet_chaos, chunked_prefill, "
+                         f"fleet, fleet_chaos, chunked_prefill, disagg, "
                          f"sharded_decode, sharded_weights, multiturn, "
                          f"kv_tiering)")
     # Same process-level GIL tuning as the cmd/scheduler.py entrypoint —

@@ -1,7 +1,8 @@
 """Fleet serving tier: cache-aware routing + snapshot load shedding +
 crash tolerance (replica health states, durable request journal,
-deterministic-replay failover) over N ``ContinuousBatcher`` replicas
-(see router.py / summary.py / health.py / journal.py)."""
+deterministic-replay failover) + disaggregated prefill/decode pools
+over N ``ContinuousBatcher`` replicas (see router.py / summary.py /
+health.py / journal.py / pools.py)."""
 from .health import (
     DEAD, HealthMonitor, HealthPolicy, LIVE, QUARANTINED, REJOINING,
     ReplicaHealth, STATES, SUSPECT,
@@ -9,6 +10,7 @@ from .health import (
 from .journal import (
     DONE, ERROR, EXPIRED, JournalEntry, JournalError, RequestJournal,
 )
+from .pools import PoolPlan, PoolPolicy, plan_pools
 from .router import FleetError, Router
 from .summary import (
     MemoryStore, ReplicaSummary, list_summaries, prefix_match_len,
@@ -27,6 +29,8 @@ __all__ = [
     "JournalError",
     "LIVE",
     "MemoryStore",
+    "PoolPlan",
+    "PoolPolicy",
     "QUARANTINED",
     "REJOINING",
     "ReplicaHealth",
@@ -36,6 +40,7 @@ __all__ = [
     "STATES",
     "SUSPECT",
     "list_summaries",
+    "plan_pools",
     "prefix_match_len",
     "prefix_match_parts",
     "publish_summary",

@@ -81,10 +81,11 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..metrics.exporter import (
     FLEET_AFFINITY_HITS_TOTAL, FLEET_COUNTERS, FLEET_EXPIRED_TOTAL,
-    FLEET_FAILOVERS_TOTAL, FLEET_GAUGES, FLEET_JOURNAL_SIZE,
+    FLEET_FAILOVERS_TOTAL, FLEET_GAUGES, FLEET_HANDOFF_DURATION,
+    FLEET_HANDOFFS_TOTAL, FLEET_HISTOGRAMS, FLEET_JOURNAL_SIZE,
     FLEET_LOST_TOTAL, FLEET_MIGRATED_TOTAL, FLEET_REPLAYED_TOKENS_TOTAL,
-    FLEET_REPLICA_STATE, FLEET_ROUTED_TOTAL, FLEET_SHED_TOTAL,
-    export_decode_fallbacks, export_serving_pool,
+    FLEET_REPLICA_ROLE, FLEET_REPLICA_STATE, FLEET_ROUTED_TOTAL,
+    FLEET_SHED_TOTAL, export_decode_fallbacks, export_serving_pool,
 )
 from ..models.lifecycle import (
     load_journal, persist_journal, resume_or_fresh,
@@ -97,6 +98,7 @@ from .health import (
     STATES, SUSPECT,
 )
 from .journal import DONE, ERROR, EXPIRED, JournalError, RequestJournal
+from .pools import PoolPlan, PoolPolicy, plan_pools
 from .summary import (
     MemoryStore, ReplicaSummary, list_summaries, prefix_match_parts,
     publish_summary, summarize,
@@ -176,6 +178,7 @@ class Router:
 
     def __init__(self, replicas: Sequence[Tuple[str, object]],
                  store=None, fleet: str = "fleet",
+                 pools: Optional[Dict[str, Sequence[str]]] = None,
                  policy: str = "affinity", stale_s: float = 5.0,
                  clock=None, tracer=None, metrics=None,
                  digest_top_k: int = 8, digest_max_tokens: int = 512,
@@ -233,6 +236,60 @@ class Router:
             self._replicas[first_id].engine.fingerprint()
         self.page_size = int(
             self._replicas[first_id].engine.replica_stats()["page_size"])
+        # Disaggregated pools (DistServe): ``pools`` PARTITIONS the
+        # replica ids into a prefill pool (role='prefill' engines —
+        # admission + chunked prefill, decode never dispatched) and a
+        # decode pool (everything else). submit() then routes new
+        # requests to the prefill pool only, and step() hands each
+        # completed prefill off to the best decode replica via the
+        # partial drain→absorb path. ``pools=None`` is the colocated
+        # fallback — today's behavior, byte-identical — under which a
+        # role='prefill' engine is REJECTED (its requests would park
+        # at the phase boundary forever with nobody to hand off to).
+        self._pools: Optional[Dict[str, List[str]]] = None
+        self._pool_of: Dict[str, str] = {}
+        if pools is not None:
+            if set(pools) != {"prefill", "decode"}:
+                raise FleetError(
+                    f"pools needs exactly the keys 'prefill' and "
+                    f"'decode', got {sorted(pools)}")
+            pre = [str(r) for r in pools["prefill"]]
+            dec = [str(r) for r in pools["decode"]]
+            if not pre or not dec:
+                raise FleetError(
+                    "each pool needs at least one replica (a 1-replica "
+                    "fleet runs colocated: pools=None)")
+            both = pre + dec
+            if (len(set(both)) != len(both)
+                    or set(both) != set(self._replicas)):
+                raise FleetError(
+                    f"pools must partition the replica ids: pools name "
+                    f"{sorted(both)}, fleet has "
+                    f"{sorted(self._replicas)}")
+            for r in pre:
+                if getattr(self._replicas[r].engine, "role",
+                           "mixed") != "prefill":
+                    raise FleetError(
+                        f"prefill-pool replica {r!r} must be built "
+                        f"with role='prefill' (its engine would "
+                        f"dispatch decode and race the handoff)")
+            for r in dec:
+                if getattr(self._replicas[r].engine, "role",
+                           "mixed") == "prefill":
+                    raise FleetError(
+                        f"decode-pool replica {r!r} has role="
+                        f"'prefill': it would never decode")
+            self._pools = {"prefill": pre, "decode": dec}
+            self._pool_of = {r: "prefill" for r in pre}
+            self._pool_of.update({r: "decode" for r in dec})
+        else:
+            for rid, rep in self._replicas.items():
+                if getattr(rep.engine, "role", "mixed") == "prefill":
+                    raise FleetError(
+                        f"replica {rid!r} has role='prefill' but the "
+                        f"router has no pools= — its requests would "
+                        f"never decode; pass pools= or build the "
+                        f"engine role='mixed'")
         self.fleet = str(fleet)
         self.policy = policy
         self.stale_s = float(stale_s)
@@ -272,6 +329,14 @@ class Router:
                 FLEET_LOST_TOTAL, FLEET_COUNTERS[FLEET_LOST_TOTAL])
             self._c_expired = metrics.counter(
                 FLEET_EXPIRED_TOTAL, FLEET_COUNTERS[FLEET_EXPIRED_TOTAL])
+            # Counter registration is exposition-safe eager (a Counter
+            # with no observations exports HELP/TYPE headers only); the
+            # handoff-duration Histogram is NOT (it eagerly exposes a
+            # zeroed unlabeled series), so it registers lazily at the
+            # first handoff — a colocated fleet's exposition stays
+            # byte-identical to pre-disagg output.
+            self._c_handoffs = metrics.counter(
+                FLEET_HANDOFFS_TOTAL, FLEET_COUNTERS[FLEET_HANDOFFS_TOTAL])
         # Health: every replica starts live; transitions drive failover.
         self._health = HealthMonitor(health, seed=health_seed)
         for rid in self._replicas:
@@ -298,6 +363,8 @@ class Router:
         # stuck or silently dropped; it finishes or it lands here.
         self.errors: Dict[int, str] = {}
         self._rr = 0                                   # round-robin cursor
+        self._handoff_rr = 0         # decode-target round-robin cursor
+        self._handoffs = 0           # completed prefill→decode handoffs
         self._degraded = 0                             # degraded routes
         self._store_errors = 0
         self._failovers = 0
@@ -427,6 +494,17 @@ class Router:
             raise FleetError(
                 f"no live replicas to route to "
                 f"(states: {self._health.counts()})")
+        if self._pools is not None:
+            # Per-phase routing: NEW admissions go to the prefill pool
+            # (chunked engines sized for TTFT), and reach the decode
+            # pool only through the phase-boundary handoff. With the
+            # whole prefill pool down, fall back to the decode pool —
+            # its engines behave like mixed replicas (role='decode' is
+            # advisory), so requests complete colocated-style instead
+            # of stranding; requests_lost stays 0 either way.
+            pool_ids = [r for r in ids
+                        if self._pool_of[r] == "prefill"]
+            ids = pool_ids or ids
         if self.policy == "affinity":
             now = self._clock.wall()
             fresh = {r: s for r, s in self.summaries().items()
@@ -795,6 +873,18 @@ class Router:
             for s in STATES:
                 g_state.set(1.0 if s == st else 0.0,
                             replica=rid, state=s)
+        # Pool topology, one-hot like replica_state: pools= mode labels
+        # by pool membership (the router's routing truth even for a
+        # mixed-role engine placed in the decode pool); colocated
+        # fleets label every replica "mixed".
+        g_role = self._metrics.gauge(FLEET_REPLICA_ROLE,
+                                     FLEET_GAUGES[FLEET_REPLICA_ROLE])
+        for rid in self._replicas:
+            role = (self._pool_of[rid] if self._pools is not None
+                    else "mixed")
+            for r in ("mixed", "prefill", "decode"):
+                g_role.set(1.0 if r == role else 0.0,
+                           replica=rid, role=r)
         self._metrics.gauge(
             FLEET_JOURNAL_SIZE,
             FLEET_GAUGES[FLEET_JOURNAL_SIZE]).set(float(len(self._journal)))
@@ -885,6 +975,11 @@ class Router:
                     self._fail_fleet_request(frid, reason)
                     continue
                 self._consumed[(rid_, lrid)] = len(toks)
+        if self._pools is not None:
+            # Phase boundary: every prefill-pool slot whose prompt is
+            # fully resident (first token emitted, journaled by the
+            # progress pass above) hands off to the decode pool now.
+            self._auto_handoff()
         self._enforce_deadlines()
         self._place_orphans()
         self.publish()
@@ -964,6 +1059,220 @@ class Router:
         except KeyError:
             raise FleetError(f"unknown replica {rid!r}") from None
 
+    def _repoint(self, src: str, dst: str,
+                 mapping: Dict[int, int]) -> int:
+        """Re-point the fleet-id bookkeeping after an absorb moved
+        requests ``src → dst`` with the returned ``{old local rid: new
+        local rid}`` mapping — shared by shed() and the disagg handoff.
+        The delivered-progress cursor rides along: absorb carries the
+        emitted stream, so the target's ``emitted()`` continues at the
+        same offset."""
+        src, dst = str(src), str(dst)
+        moved = 0
+        for (rid, lrid), frid in list(self._local.items()):
+            if rid == src and lrid in mapping:
+                del self._local[(rid, lrid)]
+                new_key = (dst, mapping[lrid])
+                self._local[new_key] = frid
+                self._where[frid] = new_key
+                self._consumed[new_key] = self._consumed.pop(
+                    (rid, lrid), 0)
+                if frid in self._journal:
+                    # reassign() only moves the placement: trace_id,
+                    # submitted_wall and — critically — deadline_wall
+                    # are untouched, so a handed-off request keeps its
+                    # ORIGINAL deadline (and a decode-pool crash later
+                    # replays with it too).
+                    self._journal.reassign(frid, dst)
+                moved += 1
+        return moved
+
+    # -- disaggregated handoff ---------------------------------------------
+    def _pick_decode_target(self, need_pages: int,
+                            ctx: Sequence[int]) -> Optional[str]:
+        """Best decode-pool replica for one completed prefill: hard
+        capacity precheck on LIVE stats (≥1 free slot and room for the
+        migrating pages — an absorb refusal after the drain would
+        orphan the request), then conversation affinity + free-capacity
+        scoring over fresh summaries (``ctx`` is prompt + delivered, so
+        a multi-turn conversation lands where its earlier turns left
+        cached pages). With any candidate's summary stale, degrade to a
+        deterministic round-robin cursor over the candidates — same
+        bounded-staleness posture as route()."""
+        pool = self._pools["decode"]
+        cands = []
+        for rid in pool:
+            rep = self._replicas[rid]
+            if rep.engine is None or not self._health.routable(rid):
+                continue
+            st = rep.engine.replica_stats()
+            if (st["n_slots"] - st["active_slots"] < 1
+                    or st["pages_free"] < need_pages):
+                continue
+            cands.append(rid)
+        if not cands:
+            return None
+        now = self._clock.wall()
+        fresh = {r: s for r, s in self.summaries().items()
+                 if now - s.published_wall <= self.stale_s}
+        if all(r in fresh for r in cands):
+            scored = sorted(
+                ((self.score(fresh[r], ctx)[0], r) for r in cands),
+                key=lambda t: (-t[0], t[1]))
+            return scored[0][1]
+        rid = cands[self._handoff_rr % len(cands)]
+        self._handoff_rr += 1
+        return rid
+
+    def _handoff_slot(self, src: str, slot: int, lrid: int,
+                      frid: int, dst: Optional[str] = None) -> bool:
+        """Hand ONE completed-prefill slot to the decode pool: partial
+        ``drain(slots=[slot])`` off the prefill replica → ``absorb()``
+        on the chosen target, pages LUT-remapped, fleet id re-pointed,
+        trace label re-attached (labels are engine-local, not wire
+        state). Returns False when no target has capacity (the slot
+        parks on the prefill replica and retries next step — admission
+        backpressure, not loss). An absorb failure AFTER the drain is
+        the handoff-in-flight crash: the request left the source with
+        the snapshot, so it is orphaned through the journal and
+        replayed like any dead-replica failover — replay routes back
+        through the prefill pool and re-reaches this boundary with the
+        ORIGINAL deadline."""
+        se = self._replicas[src].engine
+        need = se.pages_referenced([slot])
+        entry = (self._journal.entry(frid)
+                 if frid in self._journal else None)
+        ctx = (list(entry.prompt) + list(entry.delivered)
+               if entry is not None else [])
+        if dst is None:
+            dst = self._pick_decode_target(need, ctx)
+            if dst is None:
+                return False
+        t0 = self._clock.monotonic()
+        snap = se.drain(slots=[slot])
+        try:
+            mapping = self._replicas[dst].engine.absorb(snap)
+        except Exception as e:  # noqa: BLE001 — orphan, never strand
+            self._drop_placement(frid)
+            if frid in self._journal:
+                self._journal.reassign(frid, None, failover=True)
+                self._place_orphans()
+            else:
+                self._lost += 1
+                if self._metrics is not None:
+                    self._c_lost.inc(replica=src)
+            if self._tracer is not None:
+                self._tracer.event(
+                    "handoff_failed", lane="router",
+                    rid=(entry.trace_id if entry is not None
+                         and entry.trace_id is not None
+                         else f"fleet-{frid}"),
+                    src=src, dst=dst, reason=str(e))
+            return False
+        self._repoint(src, dst, mapping)
+        t1 = self._clock.monotonic()
+        self._handoffs += 1
+        new_lrid = mapping.get(lrid)
+        de = self._replicas[dst].engine
+        if (entry is not None and entry.trace_id is not None
+                and new_lrid is not None):
+            de.label_request(new_lrid, entry.trace_id)
+        if self._metrics is not None:
+            self._c_handoffs.inc(src=src, dst=dst)
+            self._metrics.histogram(
+                FLEET_HANDOFF_DURATION,
+                FLEET_HISTOGRAMS[FLEET_HANDOFF_DURATION]).observe(t1 - t0)
+        rid_label = (entry.trace_id if entry is not None
+                     and entry.trace_id is not None else f"fleet-{frid}")
+        if self._tracer is not None:
+            self._tracer.record(
+                "handoff", t0, t1, lane="router", rid=rid_label,
+                src=src, dst=dst, pages=need,
+                delivered=(len(entry.delivered)
+                           if entry is not None else 0))
+        # Flight records on BOTH engines: the per-engine rings each
+        # show their half of the migration, and the shared frid keys
+        # them to the router span — one correlated timeline per
+        # request across the pool boundary.
+        for eng, kind, lr in ((se, "handoff_out", lrid),
+                              (de, "handoff_in", new_lrid)):
+            flight = getattr(eng, "_flight", None)
+            if flight is not None:
+                flight.record(kind, frid=frid, lrid=lr,
+                              peer=(dst if kind == "handoff_out"
+                                    else src), pages=need)
+        return True
+
+    def _auto_handoff(self) -> int:
+        """Migrate every handoff-ready prefill-pool slot (prompt fully
+        resident, first token emitted) to the decode pool; runs once
+        per router step at the phase boundary. Capacity-refused slots
+        stay parked and retry next step."""
+        moved = 0
+        for src in self._pools["prefill"]:
+            rep = self._replicas[src]
+            if rep.engine is None or not self._health.serving(src):
+                continue
+            for slot, lrid in rep.engine.handoff_ready_slots():
+                frid = self._local.get((src, lrid))
+                if frid is None:
+                    continue             # not router-owned (warmup)
+                if self._handoff_slot(src, slot, lrid, frid):
+                    moved += 1
+        return moved
+
+    def handoff(self, frid: int, dst: Optional[str] = None) -> str:
+        """Manually hand one fleet request prefill→decode (the
+        auto-handoff in step() normally does this): returns the decode
+        replica it landed on. Refuses requests that are mid-prefill
+        (handoff is defined at the phase boundary only), already on the
+        decode pool, or without a live placement."""
+        if self._pools is None:
+            raise FleetError("handoff requires Router(pools=...)")
+        if frid not in self._where:
+            raise FleetError(
+                f"unknown or finished fleet request {frid}")
+        src, lrid = self._where[frid]
+        if self._pool_of.get(src) != "prefill":
+            raise FleetError(
+                f"fleet request {frid} is already on decode-pool "
+                f"replica {src!r}")
+        se = self._replicas[src].engine
+        ready = {r: s for s, r in se.handoff_ready_slots()}
+        if lrid not in ready:
+            raise FleetError(
+                f"fleet request {frid} is mid-prefill on {src!r}: "
+                f"handoff moves only completed prefills (the phase "
+                f"boundary)")
+        if dst is not None:
+            dst = str(dst)
+            if self._pool_of.get(dst) != "decode":
+                raise FleetError(
+                    f"handoff target {dst!r} is not in the decode "
+                    f"pool")
+            rep = self._replica(dst)
+            if rep.engine is None or not self._health.serving(dst):
+                raise FleetError(
+                    f"handoff target {dst!r} is not serving "
+                    f"({self._health.state(dst)})")
+        if not self._handoff_slot(src, ready[lrid], lrid, frid,
+                                  dst=dst):
+            raise FleetError(
+                f"no decode-pool replica can absorb fleet request "
+                f"{frid} right now (capacity precheck refused)")
+        return self._where[frid][0]
+
+    def pool_plan(self, policy: Optional[PoolPolicy] = None) -> PoolPlan:
+        """Advisory autoscaling plan for the two pools, computed from
+        the current summaries (fleet/pools.py): prefill scales OUT on
+        queued prefill tokens, decode scales UP on free-page/slot
+        watermarks. Pure and deterministic — the operator (or a test)
+        decides what to do with it."""
+        if self._pools is None:
+            raise FleetError("pool_plan requires Router(pools=...)")
+        return plan_pools(self.summaries(), self._pools,
+                          policy or PoolPolicy())
+
     def shed(self, src: str, dst: str,
              slots: Optional[List[int]] = None,
              max_slots: Optional[int] = None) -> int:
@@ -977,6 +1286,13 @@ class Router:
         src, dst = str(src), str(dst)
         if src == dst:
             raise FleetError("shed needs two distinct replicas")
+        if (self._pools is not None
+                and self._pool_of.get(src) != self._pool_of.get(dst)):
+            raise FleetError(
+                f"shed cannot cross pools ({src!r} is "
+                f"{self._pool_of.get(src)}, {dst!r} is "
+                f"{self._pool_of.get(dst)}): the phase boundary moves "
+                f"requests via handoff(), not load shedding")
         src_rep, dst_rep = self._replica(src), self._replica(dst)
         if src_rep.engine is None or not self._health.serving(src):
             raise FleetError(f"shed source {src!r} is not serving "
@@ -1012,21 +1328,7 @@ class Router:
         if self._metrics is not None:
             self._c_shed.inc(len(snap.slot_req), replica=str(src))
         mapping = de.absorb(snap)
-        moved = 0
-        for (rid, lrid), frid in list(self._local.items()):
-            if rid == str(src) and lrid in mapping:
-                del self._local[(rid, lrid)]
-                new_key = (str(dst), mapping[lrid])
-                self._local[new_key] = frid
-                self._where[frid] = new_key
-                # The delivered-progress cursor rides along: absorb
-                # carries the emitted stream, so the target's emitted()
-                # continues at the same offset.
-                self._consumed[new_key] = self._consumed.pop(
-                    (rid, lrid), 0)
-                if frid in self._journal:
-                    self._journal.reassign(frid, str(dst))
-                moved += 1
+        self._repoint(src, dst, mapping)
         if self._metrics is not None:
             self._c_migrated.inc(len(mapping), replica=str(dst))
         if self._tracer is not None:
@@ -1045,29 +1347,38 @@ class Router:
         slots to the coldest peer (deterministic tiebreak by id).
         Returns migrated requests (0 when no pair qualifies or the
         conservative capacity precheck refuses)."""
-        stats = {rid: rep.engine.replica_stats()
-                 for rid, rep in self._replicas.items()
-                 if rep.engine is not None and self._health.serving(rid)}
+        # Disaggregated fleets balance WITHIN each pool: shedding a
+        # prefill-pool slot to a decode replica (or back) would cross
+        # the phase boundary outside the handoff path.
+        groups = ([list(self._replicas)] if self._pools is None
+                  else [self._pools["prefill"], self._pools["decode"]])
+        moved = 0
+        for group in groups:
+            stats = {rid: self._replicas[rid].engine.replica_stats()
+                     for rid in group
+                     if self._replicas[rid].engine is not None
+                     and self._health.serving(rid)}
 
-        def frac(st):
-            return st["pages_free"] / st["pages_total"] \
-                if st["pages_total"] else 0.0
+            def frac(st):
+                return st["pages_free"] / st["pages_total"] \
+                    if st["pages_total"] else 0.0
 
-        hot = [r for r in sorted(stats)
-               if frac(stats[r]) < self.shed_free_frac
-               and stats[r]["active_slots"] > 1]
-        cold = [r for r in sorted(stats)
-                if frac(stats[r]) > self.shed_target_free_frac]
-        if not hot or not cold:
-            return 0
-        src = min(hot, key=lambda r: (frac(stats[r]), r))
-        dst = max(cold, key=lambda r: (frac(stats[r]), r))
-        if src == dst:
-            return 0
-        try:
-            return self.shed(src, dst)
-        except FleetError:
-            return 0                 # no capacity this step; retry later
+            hot = [r for r in sorted(stats)
+                   if frac(stats[r]) < self.shed_free_frac
+                   and stats[r]["active_slots"] > 1]
+            cold = [r for r in sorted(stats)
+                    if frac(stats[r]) > self.shed_target_free_frac]
+            if not hot or not cold:
+                continue
+            src = min(hot, key=lambda r: (frac(stats[r]), r))
+            dst = max(cold, key=lambda r: (frac(stats[r]), r))
+            if src == dst:
+                continue
+            try:
+                moved += self.shed(src, dst)
+            except FleetError:
+                continue             # no capacity this step; retry later
+        return moved
 
     # -- introspection -----------------------------------------------------
     def stats(self) -> Dict[str, object]:
@@ -1095,6 +1406,9 @@ class Router:
             }
         return {
             "replicas": per,
+            "pools": (None if self._pools is None
+                      else {k: list(v) for k, v in self._pools.items()}),
+            "handoffs": self._handoffs,
             "aggregate_prefix_hit_rate": hit / looked if looked else 0.0,
             "degraded_routes": self._degraded,
             "store_errors": self._store_errors,

@@ -102,6 +102,12 @@ class ReplicaSummary:
     # digest tier flags below. Default 0 keeps pre-tiering summaries
     # parsing.
     dram_cached_pages: int = 0
+    # Pool role (disaggregated serving, fleet/router.py pools=): which
+    # phase this replica serves — "prefill" (admission + chunked
+    # prefill, hands completed prefills off), "decode" (receives
+    # handoffs), or "mixed" (colocated, today's engine). Default
+    # "mixed" keeps pre-disagg summaries parsing.
+    role: str = "mixed"
     # [(token path, full cached token length)], hottest first. Tiered
     # replicas publish 3-tuples (token path, cached length, RESIDENT
     # length): resident tokens hit for free, the demoted remainder
@@ -154,6 +160,7 @@ def summarize(engine, replica: str, fleet: str = "fleet", seq: int = 0,
         tp=int(st.get("tp", 1)),
         weight_device_bytes=int(st.get("weight_device_bytes", 0)),
         dram_cached_pages=int(st.get("dram_cached_pages", 0)),
+        role=str(st.get("role", "mixed")),
         digest=engine.cache_digest(top_k, max_tokens),
     )
 

@@ -475,6 +475,15 @@ FLEET_FAILOVERS_TOTAL = "tpu_fleet_failovers_total"
 FLEET_REPLAYED_TOKENS_TOTAL = "tpu_fleet_replayed_tokens_total"
 FLEET_LOST_TOTAL = "tpu_fleet_requests_lost_total"
 FLEET_EXPIRED_TOTAL = "tpu_fleet_deadline_expired_total"
+# Disaggregated pools (fleet/router.py pools=): handoffs = completed
+# prefill→decode phase-boundary migrations (partial drain → absorb),
+# labeled {src=,dst=}. The duration histogram covers drain+absorb+
+# re-point wall time and is registered LAZILY at the first handoff — a
+# Histogram eagerly exposes zeroed unlabeled series at construction,
+# and a colocated fleet's exposition must stay byte-identical to
+# pre-disagg output (the PR 8 pin convention).
+FLEET_HANDOFFS_TOTAL = "tpu_fleet_handoffs_total"
+FLEET_HANDOFF_DURATION = "tpu_fleet_handoff_duration_seconds"
 FLEET_COUNTERS = {
     FLEET_ROUTED_TOTAL:
         "requests admitted through the fleet router, by replica/policy",
@@ -496,6 +505,18 @@ FLEET_COUNTERS = {
         "must stay 0)",
     FLEET_EXPIRED_TOTAL:
         "requests failed at the router for exceeding their deadline",
+    FLEET_HANDOFFS_TOTAL:
+        "prefill→decode pool handoffs (drain→absorb at the phase "
+        "boundary), by source/target replica",
+}
+
+# Histogram help texts live here (not inline at the registration site)
+# for the same reason the counter/gauge catalogs do: the catalog test
+# pins every tpu_fleet_* family to a non-empty HELP string.
+FLEET_HISTOGRAMS = {
+    FLEET_HANDOFF_DURATION:
+        "wall seconds per handoff: partial drain + absorb + fleet-id "
+        "re-point (lazily registered at the first handoff)",
 }
 
 # Fleet gauges: replica_state is a one-hot {replica=,state=} family (1
@@ -504,12 +525,16 @@ FLEET_COUNTERS = {
 # requests whose delivery record would drive a replay right now).
 FLEET_REPLICA_STATE = "tpu_fleet_replica_state"
 FLEET_JOURNAL_SIZE = "tpu_fleet_journal_inflight_requests"
+FLEET_REPLICA_ROLE = "tpu_fleet_replica_role"
 FLEET_GAUGES = {
     FLEET_REPLICA_STATE:
         "replica health state (fleet/health.py), one-hot over "
         "{replica=,state=live|suspect|dead|quarantined|rejoining}",
     FLEET_JOURNAL_SIZE:
         "open request-journal entries (in-flight fleet requests)",
+    FLEET_REPLICA_ROLE:
+        "replica pool role (disaggregated serving), one-hot over "
+        "{replica=,role=mixed|prefill|decode}",
 }
 
 

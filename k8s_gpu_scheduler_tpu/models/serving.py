@@ -1468,6 +1468,7 @@ class ContinuousBatcher:
                  dram_pages: Optional[int] = None,
                  kv_tier_disk: Optional[str] = None,
                  prefill_chunk_tokens: Optional[int] = None,
+                 role: str = "mixed",
                  speculative: bool = False, gamma: int = 4,
                  prefill_attn: Optional[str] = None,
                  donate_decoded: bool = True,
@@ -1547,6 +1548,28 @@ class ContinuousBatcher:
                 f"kv_layout must be 'contiguous' or 'paged', got "
                 f"{kv_layout!r}")
         self.layout = kv_layout
+        # Disaggregated serving (fleet/router.py pools=): ``role`` marks
+        # which phase this replica serves. "mixed" (default) is today's
+        # colocated engine. "prefill" runs admission + the chunked
+        # advance phase but NEVER dispatches a decode/verify step — the
+        # step loop holds ready slots until the fleet router drains them
+        # to a decode replica (drain→absorb, pages LUT-remapped).
+        # "decode" is an advisory placement label: the engine behaves
+        # exactly like mixed (it can still prefill, e.g. a failover
+        # replay landing on it), the router just never routes NEW
+        # admissions to it when pools are configured. Deliberately
+        # EXCLUDED from fingerprint(): roles differ across the pools of
+        # one fleet by design, like mesh/tp/prefill_chunk_tokens.
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'mixed', 'prefill' or 'decode', got "
+                f"{role!r}")
+        if role == "prefill" and kv_layout != "paged":
+            raise ValueError(
+                "role='prefill' requires kv_layout='paged' (handoff "
+                "drains the slot's pages to a decode replica; the "
+                "contiguous cache has no migratable pages)")
+        self.role = role
         # prefill_attn: the hb>0 tail-prefill attention implementation.
         # None/"auto" follows cfg.decode_attn (fused configs stream the
         # cached prefix through the Pallas prefix-attention kernel,
@@ -2977,6 +3000,20 @@ class ContinuousBatcher:
         finished.extend(self._advance_prefill())
         ready = {s: r for s, r in self._slot_req.items()
                  if s not in self._prefill_pending}
+        if self.role == "prefill":
+            # Prefill-pool replica: admission + advance ran above; the
+            # decode dispatch is the OTHER pool's job. Ready slots
+            # (prefill complete, first token emitted) park here until
+            # the router hands them off (drain(slots=...) → absorb on a
+            # decode replica).
+            if self._flight is not None:
+                self._flight.record("prefill_only", active=0,
+                                    held=len(ready),
+                                    admitted=self._step_admitted,
+                                    retired=len(finished),
+                                    pool_free=self._alloc.free_count,
+                                    faults=self._step_faults)
+            return finished
         if not ready:
             if self._flight is not None:
                 self._flight.record("admit_only", active=0,
@@ -3090,6 +3127,20 @@ class ContinuousBatcher:
         finished.extend(self._advance_prefill())
         ready = {s: r for s, r in self._slot_req.items()
                  if s not in self._prefill_pending}
+        if self.role == "prefill":
+            # Prefill-pool replica built speculative=True for fleet
+            # fingerprint compatibility (spec/gamma pin the page
+            # reservation every replica must agree on): it still never
+            # proposes or verifies — ready slots park for handoff, same
+            # as the lazy path.
+            if self._flight is not None:
+                self._flight.record("prefill_only", active=0,
+                                    held=len(ready),
+                                    admitted=self._step_admitted,
+                                    retired=len(finished),
+                                    pool_free=self._alloc.free_count,
+                                    faults=self._step_faults)
+            return finished
         if not ready:
             return finished
         # Proposals read the committed stream, so the prefill firsts of
@@ -3311,7 +3362,12 @@ class ContinuousBatcher:
         the same n_pages reason: the tier is pure reclaimable CAPACITY —
         a tiered drain restores onto an untiered engine (the tier
         sidecar drops, demoted tree paths truncate) and vice versa, with
-        every live stream and resident page intact. Model
+        every live stream and resident page intact. ``role`` is
+        deliberately excluded too: a disaggregated fleet's prefill and
+        decode pools differ in role BY DESIGN, and the handoff
+        (prefill-role drain → decode-pool absorb) must pass the same
+        compat check a mixed-fleet shed does — role changes which steps
+        an engine dispatches, never how a restored page decodes. Model
         WEIGHTS are the
         caller's obligation: restore into an engine holding different
         params resumes streams that decode differently, and no
@@ -3878,7 +3934,7 @@ class ContinuousBatcher:
         return mapping
 
     # -- fleet-tier inputs (fleet/summary.py reads these) ------------------
-    def replica_stats(self) -> Dict[str, int]:
+    def replica_stats(self) -> Dict[str, object]:
         """Instantaneous load numbers a fleet replica publishes for
         cache-aware routing — cheap host-side reads, no device sync."""
         if self.layout != "paged":
@@ -3889,6 +3945,10 @@ class ContinuousBatcher:
             "page_size": self.page_size,
             "pages_total": self._alloc.n_pages - 1,
             "pages_free": self._alloc.free_count,
+            # Disaggregated pools: which phase this replica serves
+            # ("mixed"/"prefill"/"decode") — the summary publishes it so
+            # registry consumers can see the pool topology.
+            "role": self.role,
             "n_slots": self.n_slots,
             "active_slots": len(self._slot_req),
             "queued": len(self._queue),
@@ -3941,6 +4001,27 @@ class ContinuousBatcher:
             seen.update(int(p) for p in self._slot_pages.get(s, ()))
         seen.discard(NULL_PAGE)
         return len(seen)
+
+    def handoff_ready_slots(self) -> list:
+        """Sorted (slot, local rid) pairs whose PREFILL IS COMPLETE —
+        bound to a request and not mid-prefill — i.e. the slots a
+        disaggregated router may drain to the decode pool. Mid-prefill
+        slots are deliberately absent: handoff is defined at the
+        phase boundary (prompt fully resident, first token emitted),
+        and migrating earlier would just move the prefill problem to
+        the pool sized for decode."""
+        if self.layout != "paged":
+            return []
+        return sorted((s, r) for s, r in self._slot_req.items()
+                      if s not in self._prefill_pending)
+
+    def label_request(self, req_id: int, label: Optional[str]) -> None:
+        """Re-attach a trace label to a live request — the router calls
+        this after absorb() hands a request a FRESH local rid (labels
+        are engine-local and deliberately not part of the snapshot wire
+        format, so cross-replica migration re-labels host-side)."""
+        if label:
+            self._rid_label[int(req_id)] = str(label)
 
     def pool_metrics(self) -> Dict[str, object]:
         """Page-pool health (paged layout only; {} otherwise): total/free/
@@ -4164,6 +4245,12 @@ class ContinuousBatcher:
         back in one readback. With eos_id set, completion IS
         content-dependent, so each step flushes before the next admission
         decision (step())."""
+        if self.role == "prefill":
+            raise RuntimeError(
+                "run() on a role='prefill' engine would spin forever: "
+                "prefill-pool replicas never dispatch decode, so "
+                "requests only complete after a fleet handoff — drive "
+                "the engine through Router(pools=...) instead")
         if self.eos_id is not None:
             done: Dict[int, list] = {}
             while self.pending:

@@ -28,9 +28,12 @@ from .trace import Span
 
 # The request-lifecycle phase taxonomy (README "Observability" documents
 # each): every engine span name is one of these; the scheduler plane adds
-# its own sched_* names on control-plane lanes.
+# its own sched_* names on control-plane lanes. "handoff" is the one
+# router-lane member — the disaggregated prefill→decode migration sits
+# between an engine's prefill phases and its peer's decode phases on the
+# same rid track.
 PHASES = ("queue", "admit", "prefill", "prefill_chunk", "decode_chunk",
-          "verify", "rewind", "reap", "drain", "restore")
+          "verify", "rewind", "reap", "drain", "restore", "handoff")
 
 _ENGINE_PID = 1
 _CONTROL_PID = 2

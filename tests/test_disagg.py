@@ -1,0 +1,540 @@
+"""Disaggregated prefill/decode pools (fleet/router.py ``pools=``).
+
+Proof obligations of the disaggregation PR:
+
+- **Phase isolation** — a ``role='prefill'`` engine admits and chunks
+  prefills but NEVER dispatches a decode/verify step: completed
+  prefills park at the phase boundary until the router hands them off.
+- **Handoff token identity** — a request that prefills on one pool and
+  decodes on the other streams byte-identically to a colocated
+  single-engine reference, across heterogeneous meshes (prefill tp=1 →
+  decode tp∈{2,4}), int8 KV, prefix cache, and speculative decode.
+- **Phase-boundary discipline** — only completed prefills hand off;
+  mid-prefill slots are refused (``FleetError``).
+- **Donation before migration** — the prefill replica keeps the
+  conversation's pages in its radix tree after the handoff, so turn 2
+  routes back to it with a prefix match.
+- **Crash tolerance across the boundary** — an absorb failure
+  mid-handoff, or a decode-replica death after it, replays from the
+  journal with the ORIGINAL deadline and the stream stays
+  byte-identical.
+- **Observability** — handoff counter/duration histogram (lazily
+  registered: a colocated fleet's exposition is untouched), one-hot
+  role gauge, a router ``handoff`` span plus ``handoff_out``/
+  ``handoff_in`` flight records correlating one request's
+  prefill→handoff→decode timeline.
+- **Pool sizing** — ``plan_pools`` is a deterministic pure function:
+  prefill scales OUT on backlog tokens, decode scales UP on free-page/
+  slot watermarks.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from k8s_gpu_scheduler_tpu.fleet import (
+    FleetError, HealthPolicy, MemoryStore, PoolPolicy, ReplicaSummary,
+    Router, plan_pools,
+)
+from k8s_gpu_scheduler_tpu.metrics.exporter import (
+    FLEET_HANDOFF_DURATION, FLEET_HANDOFFS_TOTAL, FLEET_REPLICA_ROLE,
+    Registry,
+)
+from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+from k8s_gpu_scheduler_tpu.obs import Tracer
+from k8s_gpu_scheduler_tpu.utils.retry import RetryPolicy
+
+PAGE = 8
+FAST_QUARANTINE = RetryPolicy(attempts=8, base_s=0.05, multiplier=1.0,
+                              max_s=0.1, jitter=0.5)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def tp_mesh(tp):
+    return Mesh(np.array(jax.devices()[:tp]), ("tp",))
+
+
+def mk_engine(params, cfg, role="mixed", **kw):
+    base = dict(n_slots=4, max_len=64, chunk=4, prefill_bucket=8,
+                kv_layout="paged", page_size=PAGE, prefix_cache=True,
+                role=role)
+    if role == "prefill":
+        # The prefill pool runs Sarathi-style chunked prefill — the
+        # whole point of specializing the replica.
+        base.setdefault("prefill_chunk_tokens", PAGE)
+    base.update(kw)
+    return ContinuousBatcher(params, cfg, **base)
+
+
+def mk_disagg(params, cfg, n_prefill=2, n_decode=2, pre_kw=None,
+              dec_kw=None, **router_kw):
+    pre_kw, dec_kw = dict(pre_kw or {}), dict(dec_kw or {})
+    reps = [(f"p{i}", mk_engine(params, cfg, role="prefill", **pre_kw))
+            for i in range(n_prefill)]
+    reps += [(f"d{i}", mk_engine(params, cfg, role="decode", **dec_kw))
+             for i in range(n_decode)]
+    pools = {"prefill": [f"p{i}" for i in range(n_prefill)],
+             "decode": [f"d{i}" for i in range(n_decode)]}
+    kw = dict(store=MemoryStore(), pools=pools,
+              health=HealthPolicy(quarantine=FAST_QUARANTINE))
+    kw.update(router_kw)
+    return Router(reps, **kw)
+
+
+def mk_prompts(cfg, n=8, lo=10, seed=0):
+    rng = np.random.default_rng(seed)
+    return [list(rng.integers(0, cfg.vocab, lo + i % 7))
+            for i in range(n)]
+
+
+def reference(params, cfg, prompts, max_new=8, **kw):
+    eng = mk_engine(params, cfg, **kw)
+    ids = [eng.submit(p, max_new=max_new) for p in prompts]
+    done = {}
+    while eng.pending:
+        done.update(eng.step())
+    return [done[i] for i in ids]
+
+
+# -- engine role mode ------------------------------------------------------
+class TestRoleEngine:
+    def test_role_validation(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError, match="role"):
+            mk_engine(params, cfg, role="weird")
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatcher(params, cfg, kv_layout="contiguous",
+                              role="prefill")
+
+    def test_prefill_role_never_decodes(self, setup):
+        cfg, params = setup
+        eng = mk_engine(params, cfg, role="prefill")
+        rid = eng.submit(list(range(1, 1 + 2 * PAGE)), max_new=8)
+        for _ in range(12):
+            eng.step()
+        # Prefill completed (first token emitted), then parked: the
+        # decode dispatch never ran, so the stream never grows past 1.
+        assert eng.pending
+        assert len(eng.emitted(rid)) == 1
+        ready = eng.handoff_ready_slots()
+        assert [r for _, r in ready] == [rid]
+        kinds = {r["kind"] for r in eng._flight.records()}
+        assert "prefill_only" in kinds
+        assert "decode" not in kinds and "verify" not in kinds
+
+    def test_mid_prefill_not_handoff_ready(self, setup):
+        cfg, params = setup
+        eng = mk_engine(params, cfg, role="prefill")
+        eng.submit(list(range(1, 1 + 4 * PAGE)), max_new=8)
+        eng.step()                       # admits; one 8-token chunk in
+        assert eng.handoff_ready_slots() == []
+
+    def test_run_refused_on_prefill_role(self, setup):
+        cfg, params = setup
+        eng = mk_engine(params, cfg, role="prefill")
+        eng.submit([1, 2, 3], max_new=4)
+        with pytest.raises(RuntimeError, match="spin forever"):
+            eng.run()
+
+    def test_role_excluded_from_fingerprint(self, setup):
+        cfg, params = setup
+        fp_pre = mk_engine(params, cfg, role="prefill",
+                           prefill_chunk_tokens=None).fingerprint()
+        fp_mix = mk_engine(params, cfg).fingerprint()
+        assert fp_pre == fp_mix
+        assert "role" not in fp_pre
+
+    def test_replica_stats_and_summary_carry_role(self, setup):
+        cfg, params = setup
+        eng = mk_engine(params, cfg, role="prefill")
+        assert eng.replica_stats()["role"] == "prefill"
+        from k8s_gpu_scheduler_tpu.fleet import summarize
+
+        assert summarize(eng, "p0").role == "prefill"
+
+    def test_summary_role_default_back_compat(self):
+        # A pre-disagg summary (no role key) must keep parsing.
+        s = ReplicaSummary(replica="r0", fleet="f")
+        raw = s.to_json()
+        import json
+
+        d = json.loads(raw)
+        d.pop("role")
+        old = ReplicaSummary.from_json(json.dumps(d))
+        assert old.role == "mixed"
+
+
+# -- router pool validation ------------------------------------------------
+class TestPoolsValidation:
+    def test_partition_and_role_checks(self, setup):
+        cfg, params = setup
+
+        def reps():
+            return [("p0", mk_engine(params, cfg, role="prefill")),
+                    ("d0", mk_engine(params, cfg))]
+
+        with pytest.raises(FleetError, match="keys"):
+            Router(reps(), pools={"prefill": ["p0"]})
+        with pytest.raises(FleetError, match="at least one"):
+            Router(reps(), pools={"prefill": [], "decode": ["p0", "d0"]})
+        with pytest.raises(FleetError, match="partition"):
+            Router(reps(), pools={"prefill": ["p0"], "decode": ["dX"]})
+        with pytest.raises(FleetError, match="role='prefill'"):
+            Router(reps(), pools={"prefill": ["d0"], "decode": ["p0"]})
+
+    def test_colocated_rejects_prefill_role(self, setup):
+        cfg, params = setup
+        with pytest.raises(FleetError, match="pools"):
+            Router([("r0", mk_engine(params, cfg, role="prefill"))])
+
+    def test_colocated_fallback_unchanged(self, setup):
+        cfg, params = setup
+        prompts = mk_prompts(cfg, n=4)
+        ref = reference(params, cfg, prompts)
+        rtr = Router([("r0", mk_engine(params, cfg))])
+        frids = [rtr.submit(p, max_new=8) for p in prompts]
+        done = rtr.run()
+        assert [done[f] for f in frids] == ref
+        assert rtr.stats()["pools"] is None
+        assert rtr.stats()["handoffs"] == 0
+
+
+# -- handoff end-to-end ----------------------------------------------------
+class TestDisaggServing:
+    def test_token_identity_and_handoff_accounting(self, setup):
+        cfg, params = setup
+        prompts = mk_prompts(cfg, n=8)
+        ref = reference(params, cfg, prompts)
+        rtr = mk_disagg(params, cfg)
+        frids = [rtr.submit(p, max_new=8, trace_id=f"t{i}")
+                 for i, p in enumerate(prompts)]
+        # Every NEW admission lands on the prefill pool.
+        assert {rtr.locate(f)[0] for f in frids} <= {"p0", "p1"}
+        done = rtr.run()
+        assert [done[f] for f in frids] == ref
+        st = rtr.stats()
+        assert st["handoffs"] == len(prompts)
+        assert st["requests_lost"] == 0
+        assert rtr.errors == {}
+        for rep in rtr._replicas.values():
+            rep.engine._alloc.assert_consistent()
+
+    def test_decode_pool_fallback_when_prefill_down(self, setup):
+        cfg, params = setup
+        prompts = mk_prompts(cfg, n=4)
+        ref = reference(params, cfg, prompts)
+        rtr = mk_disagg(params, cfg, n_prefill=1, n_decode=2)
+        rtr._crash("p0", RuntimeError("chaos"))
+        frids = [rtr.submit(p, max_new=8) for p in prompts]
+        # Degraded to the decode pool (colocated-style): requests
+        # complete without a prefill replica, nothing lost.
+        assert {rtr.locate(f)[0] for f in frids} <= {"d0", "d1"}
+        done = rtr.run()
+        assert [done[f] for f in frids] == ref
+        assert rtr.stats()["requests_lost"] == 0
+
+    def test_manual_handoff_and_mid_prefill_rejection(self, setup):
+        cfg, params = setup
+        rtr = mk_disagg(params, cfg, n_prefill=1, n_decode=1)
+        frid = rtr.submit(list(range(1, 1 + 4 * PAGE)), max_new=4)
+        eng = rtr._replicas["p0"].engine
+        eng.step()                       # admit + first chunk only
+        assert eng.handoff_ready_slots() == []
+        with pytest.raises(FleetError, match="mid-prefill"):
+            rtr.handoff(frid)
+        while eng.handoff_ready_slots() == []:
+            eng.step()                   # finish the prefill
+        dst = rtr.handoff(frid)
+        assert dst == "d0"
+        assert rtr.locate(frid)[0] == "d0"
+        with pytest.raises(FleetError, match="already on decode"):
+            rtr.handoff(frid)
+        done = rtr.run()
+        assert len(done[frid]) == 4
+
+    def test_shed_cannot_cross_pools(self, setup):
+        cfg, params = setup
+        rtr = mk_disagg(params, cfg, n_prefill=1, n_decode=1)
+        with pytest.raises(FleetError, match="cross pools"):
+            rtr.shed("p0", "d0")
+
+    def test_prefill_side_donation_routes_turn2_back(self, setup):
+        cfg, params = setup
+        rtr = mk_disagg(params, cfg, n_prefill=2, n_decode=1)
+        rng = np.random.default_rng(7)
+        turn1 = list(rng.integers(0, cfg.vocab, 3 * PAGE))
+        frid = rtr.submit(turn1, max_new=4)
+        src = rtr.locate(frid)[0]
+        done = rtr.run()
+        # The conversation's pages were donated into SRC's tree before
+        # the pages migrated: turn 2 scores a prefix match there and
+        # routes back to the same prefill replica.
+        turn2 = turn1 + done[frid] + [5, 6, 7]
+        rid, policy, match = rtr.route(turn2)
+        assert policy == "affinity"
+        assert rid == src
+        assert match >= 2 * PAGE
+
+
+# -- crash tolerance across the boundary -----------------------------------
+class TestHandoffFailover:
+    def test_absorb_failure_mid_handoff_replays(self, setup):
+        cfg, params = setup
+        rtr = mk_disagg(params, cfg, n_prefill=1, n_decode=1)
+        prompts = mk_prompts(cfg, n=2)
+        ref = reference(params, cfg, prompts)
+        de = rtr._replicas["d0"].engine
+        real_absorb = de.absorb
+        boom = {"n": 1}
+
+        def flaky_absorb(snap):
+            if boom["n"]:
+                boom["n"] -= 1
+                raise RuntimeError("absorb died mid-handoff")
+            return real_absorb(snap)
+
+        de.absorb = flaky_absorb
+        frids = [rtr.submit(p, max_new=8, deadline_s=300.0)
+                 for p in prompts]
+        deadlines = {f: rtr.journal.entry(f).deadline_wall
+                     for f in frids}
+        # Step until the injected absorb failure has fired: the victim
+        # was orphaned through the journal mid-handoff and immediately
+        # replayed — with its ORIGINAL deadline (reassign only moves
+        # the placement).
+        while boom["n"]:
+            rtr.step()
+        for f in frids:
+            if f in rtr.journal:
+                assert rtr.journal.entry(f).deadline_wall \
+                    == deadlines[f]
+        done = rtr.run()
+        assert [done[f] for f in frids] == ref
+        assert rtr.stats()["requests_lost"] == 0
+        assert rtr.errors == {}
+
+    def test_decode_replica_death_after_handoff(self, setup):
+        cfg, params = setup
+        prompts = mk_prompts(cfg, n=3)
+        ref = reference(params, cfg, prompts, max_new=12)
+        rtr = mk_disagg(params, cfg, n_prefill=1, n_decode=2)
+        frids = [rtr.submit(p, max_new=12, trace_id=f"t{i}",
+                            deadline_s=300.0)
+                 for i, p in enumerate(prompts)]
+        deadlines = {f: rtr.journal.entry(f).deadline_wall
+                     for f in frids}
+        # Step until something decodes on d0, then kill it.
+        victim = None
+        for _ in range(30):
+            rtr.step()
+            on_d0 = [f for f in frids if f in rtr._where
+                     and rtr._where[f][0] == "d0"]
+            if on_d0:
+                victim = on_d0[0]
+                break
+        assert victim is not None
+        rtr._crash("d0", RuntimeError("decode pool crash"))
+        # The orphan replays THROUGH the prefill pool (route() is
+        # pool-restricted), re-prefills prompt+delivered, and hands
+        # off again — deadline untouched the whole way.
+        assert victim in rtr.journal
+        assert rtr.journal.entry(victim).deadline_wall \
+            == deadlines[victim]
+        done = rtr.run()
+        assert [done[f] for f in frids] == ref
+        st = rtr.stats()
+        assert st["requests_lost"] == 0
+        assert st["failovers"] >= 1
+        assert rtr.errors == {}
+
+
+# -- metrics + obs ---------------------------------------------------------
+class TestDisaggObservability:
+    def test_handoff_metrics_and_role_gauge(self, setup):
+        cfg, params = setup
+        reg = Registry()
+        rtr = mk_disagg(params, cfg, n_prefill=1, n_decode=1,
+                        metrics=reg)
+        # Lazy histogram: nothing handed off yet → no family exposed.
+        assert FLEET_HANDOFF_DURATION not in reg.expose()
+        frid = rtr.submit(mk_prompts(cfg, n=1)[0], max_new=4)
+        rtr.run()
+        text = reg.expose()
+        assert (f'{FLEET_HANDOFFS_TOTAL}{{dst="d0",src="p0"}} 1.0'
+                in text or f'{FLEET_HANDOFFS_TOTAL}{{src="p0",dst="d0"}}'
+                in text)
+        assert f"{FLEET_HANDOFF_DURATION}_count" in text
+        assert (f'{FLEET_REPLICA_ROLE}{{replica="p0",role="prefill"}} 1.0'
+                in text)
+        assert (f'{FLEET_REPLICA_ROLE}{{replica="d0",role="decode"}} 1.0'
+                in text)
+        assert (f'{FLEET_REPLICA_ROLE}{{replica="p0",role="decode"}} 0.0'
+                in text)
+        assert frid not in rtr.journal   # closed DONE
+
+    def test_colocated_role_gauge_is_mixed(self, setup):
+        cfg, params = setup
+        reg = Registry()
+        Router([("r0", mk_engine(params, cfg))], metrics=reg)
+        assert (f'{FLEET_REPLICA_ROLE}{{replica="r0",role="mixed"}} 1.0'
+                in reg.expose())
+
+    def test_handoff_span_and_flight_correlation(self, setup):
+        cfg, params = setup
+        tracer = Tracer()
+        rtr = mk_disagg(params, cfg, n_prefill=1, n_decode=1,
+                        tracer=tracer,
+                        pre_kw=dict(tracer=tracer),
+                        dec_kw=dict(tracer=tracer))
+        frid = rtr.submit(list(range(1, 1 + 2 * PAGE)), max_new=6,
+                          trace_id="conv-1")
+        rtr.run()
+        # One correlated timeline: prefill chunks on the source, the
+        # router handoff span, decode chunks on the target — all under
+        # the SAME rid label (label_request re-attaches it post-absorb).
+        names = {s.name for s in tracer.spans(rid="conv-1")}
+        assert "prefill_chunk" in names
+        assert "handoff" in names
+        assert "decode_chunk" in names
+        h = tracer.spans(rid="conv-1", name="handoff")
+        assert h and h[0].lane == "router"
+        assert h[0].attrs["src"] == "p0" and h[0].attrs["dst"] == "d0"
+        # Flight records on both engines, keyed by the fleet id.
+        src_recs = rtr._replicas["p0"].engine._flight.records(
+            "handoff_out")
+        dst_recs = rtr._replicas["d0"].engine._flight.records(
+            "handoff_in")
+        assert [r["frid"] for r in src_recs] == [frid]
+        assert [r["frid"] for r in dst_recs] == [frid]
+
+
+# -- pool sizing policy ----------------------------------------------------
+class TestPoolPlan:
+    @staticmethod
+    def _summ(rid, backlog=0, pages_total=32, pages_free=32,
+              n_slots=4, active=0):
+        return ReplicaSummary(
+            replica=rid, fleet="f", page_size=PAGE,
+            pages_total=pages_total, pages_free=pages_free,
+            n_slots=n_slots, active_slots=active,
+            prefill_backlog_tokens=backlog)
+
+    def test_prefill_scales_out_on_backlog(self):
+        pools = {"prefill": ["p0", "p1"], "decode": ["d0"]}
+        summaries = {"p0": self._summ("p0", backlog=9000),
+                     "p1": self._summ("p1", backlog=5000),
+                     "d0": self._summ("d0")}
+        plan = plan_pools(summaries, pools,
+                          PoolPolicy(prefill_tokens_per_replica=4096))
+        assert plan.prefill_backlog_tokens == 14000
+        assert plan.prefill_replicas == 2
+        assert plan.prefill_replicas_desired == 4   # ceil(14000/4096)
+        assert not plan.decode_scale_up
+        assert plan.decode_pages_desired == plan.decode_pages_total == 32
+
+    def test_decode_scales_up_on_watermarks(self):
+        pools = {"prefill": ["p0"], "decode": ["d0", "d1"]}
+        summaries = {"p0": self._summ("p0"),
+                     "d0": self._summ("d0", pages_free=2,
+                                      active=4),     # starved
+                     "d1": self._summ("d1")}
+        plan = plan_pools(summaries, pools, PoolPolicy())
+        assert plan.decode_scale_up
+        assert plan.decode_pages_total == 64
+        assert plan.decode_pages_desired == 128      # 2x headroom
+        assert plan.prefill_replicas_desired == 1
+        assert any("free-page" in r for r in plan.reasons)
+
+    def test_plan_is_deterministic_and_ignores_missing(self):
+        pools = {"prefill": ["p0"], "decode": ["d0", "dGONE"]}
+        summaries = {"p0": self._summ("p0", backlog=100),
+                     "d0": self._summ("d0")}
+        a = plan_pools(summaries, pools)
+        b = plan_pools(summaries, pools)
+        assert a == b
+        assert a.decode_replicas == 1                # dGONE unobserved
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            PoolPolicy(prefill_tokens_per_replica=0)
+        with pytest.raises(ValueError):
+            PoolPolicy(decode_free_page_frac_low=1.5)
+        with pytest.raises(ValueError):
+            PoolPolicy(decode_page_headroom=0.5)
+
+    def test_router_pool_plan_wrapper(self, setup):
+        cfg, params = setup
+        rtr = mk_disagg(params, cfg, n_prefill=1, n_decode=1)
+        plan = rtr.pool_plan()
+        assert plan.prefill_replicas == 1
+        assert plan.decode_replicas == 1
+        colo = Router([("r0", mk_engine(params, cfg))])
+        with pytest.raises(FleetError, match="pools"):
+            colo.pool_plan()
+
+
+# -- cross-tp / feature handoff grid ---------------------------------------
+def run_disagg_grid(setup, dec_tp, pre_kw=None, dec_kw=None, max_new=8):
+    cfg, params = setup
+    prompts = mk_prompts(cfg, n=4, lo=12, seed=3)
+    pre_kw = dict(pre_kw or {})
+    dec_kw = dict(dec_kw or {})
+    if dec_tp > 1:
+        dec_kw["mesh"] = tp_mesh(dec_tp)
+    ref = reference(params, cfg, prompts, max_new=max_new,
+                    **{k: v for k, v in dec_kw.items() if k != "mesh"})
+    rtr = mk_disagg(params, cfg, n_prefill=1, n_decode=1,
+                    pre_kw=pre_kw, dec_kw=dec_kw)
+    frids = [rtr.submit(p, max_new=max_new) for p in prompts]
+    done = rtr.run()
+    assert [done[f] for f in frids] == ref
+    st = rtr.stats()
+    assert st["handoffs"] >= len(prompts)
+    assert st["requests_lost"] == 0
+    for rep in rtr._replicas.values():
+        rep.engine._alloc.assert_consistent()
+
+
+class TestCrossTpHandoff:
+    def test_tp1_prefill_to_tp2_decode(self, setup):
+        run_disagg_grid(setup, dec_tp=2)
+
+    @pytest.mark.slow
+    def test_tp1_prefill_to_tp4_decode(self, setup):
+        run_disagg_grid(setup, dec_tp=4)
+
+    @pytest.mark.slow
+    def test_tp2_decode_int8_kv(self, setup):
+        run_disagg_grid(setup, dec_tp=2,
+                        pre_kw=dict(kv_dtype="int8"),
+                        dec_kw=dict(kv_dtype="int8"))
+
+    @pytest.mark.slow
+    def test_tp2_decode_no_prefix_cache(self, setup):
+        run_disagg_grid(setup, dec_tp=2,
+                        pre_kw=dict(prefix_cache=False),
+                        dec_kw=dict(prefix_cache=False))
+
+    @pytest.mark.slow
+    def test_tp2_decode_speculative(self, setup):
+        # speculative=True FLEET-WIDE (fingerprint pins spec/gamma for
+        # page-reservation safety); the prefill-role engine never
+        # proposes or verifies — spec there is a compat declaration.
+        run_disagg_grid(setup, dec_tp=2,
+                        pre_kw=dict(speculative=True, gamma=2),
+                        dec_kw=dict(speculative=True, gamma=2))
+
+    def test_speculative_handoff_tp1(self, setup):
+        run_disagg_grid(setup, dec_tp=1,
+                        pre_kw=dict(speculative=True, gamma=2),
+                        dec_kw=dict(speculative=True, gamma=2))

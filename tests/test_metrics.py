@@ -406,10 +406,12 @@ class TestServingPoolExport:
                 '{policy="degraded",replica="r1"} 2.0') in text
 
     def test_fleet_gauges_catalogued_one_hot_state(self):
-        """The crash-tolerance gauges: replica_state is a one-hot
-        {replica=,state=} family, the journal gauge a plain level."""
+        """The crash-tolerance + disagg gauges: replica_state and
+        replica_role are one-hot {replica=,...} families, the journal
+        gauge a plain level."""
         from k8s_gpu_scheduler_tpu.metrics.exporter import (
-            FLEET_GAUGES, FLEET_JOURNAL_SIZE, FLEET_REPLICA_STATE,
+            FLEET_GAUGES, FLEET_JOURNAL_SIZE, FLEET_REPLICA_ROLE,
+            FLEET_REPLICA_STATE,
         )
 
         reg = Registry()
@@ -417,6 +419,10 @@ class TestServingPoolExport:
                       FLEET_GAUGES[FLEET_REPLICA_STATE])
         for state, v in (("live", 0.0), ("quarantined", 1.0)):
             g.set(v, replica="r0", state=state)
+        role = reg.gauge(FLEET_REPLICA_ROLE,
+                         FLEET_GAUGES[FLEET_REPLICA_ROLE])
+        for r, v in (("prefill", 1.0), ("mixed", 0.0)):
+            role.set(v, replica="r0", role=r)
         reg.gauge(FLEET_JOURNAL_SIZE,
                   FLEET_GAUGES[FLEET_JOURNAL_SIZE]).set(3)
         text = reg.expose()
@@ -426,6 +432,10 @@ class TestServingPoolExport:
                 '{replica="r0",state="quarantined"} 1.0') in text
         assert ('tpu_fleet_replica_state'
                 '{replica="r0",state="live"} 0.0') in text
+        assert ('tpu_fleet_replica_role'
+                '{replica="r0",role="prefill"} 1.0') in text
+        assert ('tpu_fleet_replica_role'
+                '{replica="r0",role="mixed"} 0.0') in text
         assert "tpu_fleet_journal_inflight_requests 3.0" in text
 
     def test_absent_keys_are_skipped(self):
