@@ -1095,9 +1095,10 @@ def bench_speculative(smoke=False):
         phrase = list(rng.integers(0, cfg.vocab, phrase_len))
         workload.append(phrase * reps)
 
-    def drive(spec: bool):
+    def drive(spec: bool, **kw):
         eng = ContinuousBatcher(params, cfg, kv_layout="paged",
-                                speculative=spec, gamma=gamma, **eng_kw)
+                                speculative=spec, gamma=gamma, **eng_kw,
+                                **kw)
         # Warm OUTSIDE the measured window: compiles the prefill rung and
         # the verify (or decode-chunk) program.
         eng.submit(workload[0], max_new=2)
@@ -1113,6 +1114,32 @@ def bench_speculative(smoke=False):
     toks_on, wall_on, eng_on = drive(True)
     toks_off, wall_off, _ = drive(False)
     m = eng_on.pool_metrics()
+    # Sampled rows: rejection-sampling verify at a temperature well under
+    # the logit scale (random-init weights leave logits nearly flat, so
+    # the repetitive stream only self-locks — and proposals only accept —
+    # once p sharpens; a trained model reaches this regime at ordinary
+    # temperatures). Replay determinism doubles as the cheap in-bench
+    # distribution check: the sampled stream is a pure function of the
+    # seeded PRNG chain, so two identical drives must agree exactly
+    # (the full TV-distance equivalence test lives in
+    # tests/test_speculative_batcher.py).
+    temp = 0.005
+    toks_s1, wall_s, eng_s = drive(True, temperature=temp)
+    toks_s2, _, _ = drive(True, temperature=temp)
+    ms = eng_s.pool_metrics()
+    # Adaptive row: the accept-rate EMA sizes per-slot effective windows.
+    _, wall_a, eng_a = drive(True, temperature=temp, spec_adaptive=True)
+    ma = eng_a.pool_metrics()
+    # Draft row: a draft proposer sharing the target weights and sampler
+    # is the q == p full-accept ceiling — accept machinery at its limit
+    # (a REAL deployment pairs a much smaller draft; this row isolates
+    # the verify/accept cost at accept-rate 1).
+    from k8s_gpu_scheduler_tpu.models.proposers import DraftModelProposer
+
+    draft = DraftModelProposer(cfg, params, temperature=temp,
+                               ctx=min(64, cfg.max_seq))
+    _, wall_d, eng_d = drive(True, temperature=temp, proposer=draft)
+    md = eng_d.pool_metrics()
     extra = {
         "spec_shape": f"{n_req} reqs x ({phrase_len}-tok phrase x {reps}), "
                       f"max_new {max_new}, gamma {gamma}",
@@ -1124,6 +1151,19 @@ def bench_speculative(smoke=False):
         "spec_off_tok_s": round(n_req * max_new / wall_off, 1),
         "spec_speedup": round(wall_off / wall_on, 3) if wall_on else None,
         "spec_token_identity": toks_on == toks_off,
+        "spec_sampled_temperature": temp,
+        "spec_sampled_accept_rate": round(ms["spec_accept_rate"], 4),
+        "spec_sampled_tokens_per_dispatch":
+            round(ms["spec_tokens_per_dispatch"], 3),
+        "spec_sampled_tok_s": round(n_req * max_new / wall_s, 1),
+        "spec_sampled_replay_identity": toks_s1 == toks_s2,
+        "spec_adaptive_tokens_per_dispatch":
+            round(ma["spec_tokens_per_dispatch"], 3),
+        "spec_adaptive_gamma_mean":
+            round(ma["spec_gamma_agg"]["mean"], 3),
+        "spec_draft_accept_rate": round(md["spec_accept_rate"], 4),
+        "spec_draft_tokens_per_dispatch":
+            round(md["spec_tokens_per_dispatch"], 3),
     }
     return {
         "metric": "speculative_bench",

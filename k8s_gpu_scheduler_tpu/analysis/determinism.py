@@ -64,6 +64,7 @@ DETERMINISM_SCOPE: Tuple[str, ...] = (
     "models/snapshot.py",
     "models/prefix_cache.py",
     "models/paging.py",
+    "models/proposers.py",
     "sched/scheduler.py",
     "sched/framework.py",
 )

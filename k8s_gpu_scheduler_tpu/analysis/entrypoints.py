@@ -118,7 +118,8 @@ def jaxpr_entrypoints() -> List[Tuple[str, Callable, tuple]]:
         "batcher_verify_paged_spec", seng._decode,
         (params, seng._k, seng._v, seng._ks, seng._vs,
          seng._table_np.copy(), seng._lens, seng._last,
-         np.zeros((2, 2), np.int32), np.asarray([True, False]))))
+         np.zeros((2, 2), np.int32), np.asarray([True, False]),
+         np.int32(1), np.full((2,), 2, np.int32))))
 
     # Pipeline train step (pp >= 2 needs >= 2 local devices; conftest/CLI
     # request an 8-device CPU mesh before jax initializes).
@@ -227,6 +228,13 @@ def traffic_contracts() -> Dict[str, "object"]:
         # attend itself (W²) on the dense reference path.
         "traffic_verify_window": TrafficContract(
             kv_scale={"S": 1, "W": 2}, donated=(1, 2, 3, 4, 5)),
+        # Sampling verify branch (temperature > 0): rejection sampling
+        # replaces the exact-match cumprod but stays in the SAME traffic
+        # class — per-position softmax/uniform/categorical are all
+        # O(W·vocab) with no new pool-scale intermediates, and the
+        # pool/scales/table donation chain is unchanged.
+        "traffic_verify_window_sampled": TrafficContract(
+            kv_scale={"S": 1, "W": 2}, donated=(1, 2, 3, 4, 5)),
         # Plain prefill rung (hb=0): the tail attends itself causally —
         # tb² scores — and nothing else.
         "traffic_prefill_tb16_hb0": TrafficContract(
@@ -299,7 +307,8 @@ def traffic_contracts() -> Dict[str, "object"]:
 def _traffic_engine(speculative: bool = False,
                     prefill_attn=None, tp: bool = False,
                     weight_sharding: bool = True,
-                    tp_combine: str = "all_gather"):
+                    tp_combine: str = "all_gather",
+                    temperature: float = 0.0):
     """A paged audit engine at the TRAFFIC_GEOMETRY shapes (fused decode,
     int8 KV — every operand class in play). tp entries default to the
     runtime default — Megatron-sliced weights, all_gather combine —
@@ -317,6 +326,8 @@ def _traffic_engine(speculative: bool = False,
     kw: dict = {}
     if speculative:
         kw.update(speculative=True, gamma=4)
+    if temperature:
+        kw.update(temperature=temperature, top_k=8)
     if tp:
         kw.update(mesh=_audit_mesh(), weight_sharding=weight_sharding,
                   tp_combine=tp_combine)
@@ -340,6 +351,8 @@ def _traffic_engine(speculative: bool = False,
 _TRAFFIC_ENTRIES: Tuple[Tuple[str, dict], ...] = (
     ("traffic_decode_chunk", {"kind": "decode"}),
     ("traffic_verify_window", {"kind": "verify"}),
+    ("traffic_verify_window_sampled",
+     {"kind": "verify", "temperature": 0.6}),
     ("traffic_prefill_tb16_hb0", {"kind": "prefill", "hb": 0}),
     ("traffic_prefill_tb16_hb4_kernel",
      {"kind": "prefill", "hb": 4, "attn": "kernel"}),
@@ -363,7 +376,8 @@ _TRAFFIC_ENTRIES: Tuple[Tuple[str, dict], ...] = (
 
 def _make_traffic_build(kind: str, hb: int = 0, attn=None,
                         tp: bool = False, ws: bool = True,
-                        combine: str = "all_gather") -> Callable[[], tuple]:
+                        combine: str = "all_gather",
+                        temperature: float = 0.0) -> Callable[[], tuple]:
     def build():
         if kind == "promote":
             # The tier promotion upload: the REAL relocation primitive
@@ -393,12 +407,14 @@ def _make_traffic_build(kind: str, hb: int = 0, attn=None,
                 np.asarray([True, True, False]), np.int32(2))
         if kind == "verify":
             eng = _traffic_engine(speculative=True, tp=tp,
-                                  weight_sharding=ws, tp_combine=combine)
+                                  weight_sharding=ws, tp_combine=combine,
+                                  temperature=temperature)
             return eng._decode, (
                 eng.params, eng._k, eng._v, eng._ks, eng._vs,
                 eng._table_np.copy(), eng._lens, eng._last,
                 np.zeros((3, 4), np.int32),
-                np.asarray([True, True, False]))
+                np.asarray([True, True, False]),
+                np.int32(2), np.full((3,), 4, np.int32))
         eng = _traffic_engine(prefill_attn=attn, tp=tp,
                               weight_sharding=ws, tp_combine=combine)
         slots = np.arange(3, dtype=np.int32)
@@ -546,7 +562,8 @@ def gspmd_entrypoints() -> List[Tuple[str, Callable, tuple, dict]]:
         "batcher_verify_paged_tp", seng._decode,
         (seng.params, seng._k, seng._v, seng._ks, seng._vs,
          seng._table_np.copy(), seng._lens, seng._last,
-         np.zeros((2, 2), np.int32), np.asarray([True, False])),
+         np.zeros((2, 2), np.int32), np.asarray([True, False]),
+         np.int32(1), np.full((2,), 2, np.int32)),
         dict(wspec)))
     # psum combine: same sliced-weight expectations — the combine only
     # changes the body's collectives, never the operand layout.
@@ -903,6 +920,52 @@ def _paged_spec_batcher_scenario() -> tuple:
     return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
 
 
+def _paged_spec_sampled_batcher_scenario() -> tuple:
+    """Sampled + adaptive edition of the speculative scenario: steady
+    state now varies BOTH the accept lengths (repetitive prompts accept,
+    random prompts reject — rejection sampling, not exact match) AND the
+    per-slot effective gamma (spec_adaptive — the accept-rate EMA
+    shrinks/reopens windows between dispatches). Both ride TRACED
+    operands (seed counter, eff vector) against the fixed 1+gamma_max
+    padded window, so one compiled verify program must serve every wave
+    — an eff- or seed-keyed retrace here would recompile per dispatch in
+    steady state. Donation of the pool + table through the sampled
+    branch is pinned separately in donation_audit()."""
+    import dataclasses
+
+    from ..models.serving import ContinuousBatcher
+
+    cfg, params = _tiny()
+    eng = ContinuousBatcher(params, dataclasses.replace(cfg,
+                                                        decode_attn="fused"),
+                            n_slots=2, max_len=48, chunk=2,
+                            prefill_bucket=8, kv_dtype="int8",
+                            kv_layout="paged", page_size=8,
+                            speculative=True, gamma=2,
+                            spec_adaptive=True,
+                            temperature=0.8, top_k=8)
+    rng = np.random.default_rng(0)
+    phrase = list(rng.integers(0, cfg.vocab, 3))
+
+    def warmup():
+        # Covers the prefill rung, the sampled verify program under BOTH
+        # block-table jit keys, and a multi-step drain — long enough for
+        # the adaptive EMA to move off its fleet seed.
+        eng.submit(phrase * 2, max_new=4)
+        eng.run()
+
+    def wave(plen: int):
+        def go():
+            eng.submit(phrase * 2 + phrase[:plen - 6], max_new=3)
+            eng.submit(list(rng.integers(0, cfg.vocab, plen - 1)),
+                       max_new=2)
+            eng.run()
+        return go
+
+    steady = [wave(6), wave(7), wave(8)]
+    return warmup, steady, {"decode": eng._decode, "prefill": eng._prefill}
+
+
 def _sharded_paged_batcher_scenario(weight_sharding: bool = False) -> tuple:
     """Multi-chip edition of the paged scenario: steady-state decode on a
     FORCED multi-device host mesh (shard_map islands over tp, pool
@@ -971,6 +1034,8 @@ def recompile_scenarios() -> List[Tuple[str, Callable[[], tuple]]]:
         ("batcher_steady_decode_paged_prefix", _paged_prefix_batcher_scenario),
         ("batcher_steady_decode_paged_tiered", _paged_tiered_batcher_scenario),
         ("batcher_steady_decode_paged_spec", _paged_spec_batcher_scenario),
+        ("batcher_steady_decode_paged_spec_sampled",
+         _paged_spec_sampled_batcher_scenario),
         ("batcher_steady_mixed_chunked", _paged_chunked_batcher_scenario),
         ("batcher_steady_decode_paged_tp", _sharded_paged_batcher_scenario),
         ("batcher_steady_decode_paged_tp_wsharded",
@@ -1029,10 +1094,29 @@ def donation_audit() -> List:
     sargs = (params, seng._k, seng._v, seng._ks, seng._vs,
              jnp.asarray(seng._table_np), jnp.zeros((2,), jnp.int32),
              jnp.zeros((2,), jnp.int32), np.zeros((2, 2), np.int32),
-             np.asarray([True, True]))
+             np.asarray([True, True]), np.int32(1),
+             np.full((2,), 2, np.int32))
     findings += check_donation(seng._decode, *sargs,
                                donated=(1, 2, 3, 4, 5),
                                name="batcher_verify_paged_spec")
+
+    # Sampled verify (temperature > 0): the rejection-sampling branch
+    # adds seed/eff operands — REPLICATED and never donated — while the
+    # pool/scales/table contract must stay exactly (1..5): a donation
+    # slip here would double the pool on every sampled verify.
+    szeng = ContinuousBatcher(params, cfg, n_slots=2, max_len=32, chunk=2,
+                              prefill_bucket=4, kv_dtype="int8",
+                              kv_layout="paged", page_size=8,
+                              speculative=True, gamma=2,
+                              temperature=0.7, top_k=4)
+    szargs = (params, szeng._k, szeng._v, szeng._ks, szeng._vs,
+              jnp.asarray(szeng._table_np), jnp.zeros((2,), jnp.int32),
+              jnp.zeros((2,), jnp.int32), np.zeros((2, 2), np.int32),
+              np.asarray([True, True]), np.int32(1),
+              np.full((2,), 2, np.int32))
+    findings += check_donation(szeng._decode, *szargs,
+                               donated=(1, 2, 3, 4, 5),
+                               name="batcher_verify_paged_spec_sampled")
 
     # Tail prefill (prefix-cache hit shape): the pool + scale planes must
     # donate through the hb>0 program too — a copy here would double the
@@ -1286,7 +1370,8 @@ def _alias_verify_scenario() -> tuple:
     props = np.zeros((2, eng.gamma), np.int32)
     args = (eng.params, eng._k, eng._v, eng._ks, eng._vs,
             eng._table_np.copy(), eng._lens, eng._last, props,
-            np.asarray([s in eng._slot_req for s in range(eng.n_slots)]))
+            np.asarray([s in eng._slot_req for s in range(eng.n_slots)]),
+            np.int32(1), np.full((eng.n_slots,), eng.gamma, np.int32))
     # _decode (spec) returns (k, v, k_s, v_s, table, lens, last, toks,
     # accepts).
     return eng._decode, args, (1, 2, 3, 4), (0, 1, 2, 3), shared

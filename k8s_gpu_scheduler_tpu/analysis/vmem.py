@@ -284,8 +284,10 @@ def audit_vmem(budget: int = VMEM_BYTES_PER_CORE) -> List[Finding]:
     # Speculation windows the serving engine actually dispatches
     # (ContinuousBatcher speculative=True / generate_speculative): the
     # verify kernel's q side scales with t = 1+gamma, so every preset is
-    # checked at the realistic gamma range too.
-    gammas = (2, 4)
+    # checked at the realistic gamma range too — including the padded
+    # gamma_max window an adaptive-gamma engine always dispatches
+    # (effective windows shrink acceptance, never the kernel shapes).
+    gammas = (2, 4, 8)
     for name, cfg, meta in _presets():
         g = cfg.n_heads // cfg.n_kv_heads
         for s in meta["cache_lens"]:

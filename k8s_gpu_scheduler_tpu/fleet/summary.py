@@ -108,6 +108,12 @@ class ReplicaSummary:
     # handoffs), or "mixed" (colocated, today's engine). Default
     # "mixed" keeps pre-disagg summaries parsing.
     role: str = "mixed"
+    # Lifetime speculative accept rate (proposals accepted / proposed,
+    # models/serving.py spec gauges): how well this replica's current
+    # traffic mix speculates — a router can prefer high-accept replicas
+    # for throughput-priority requests. 0.0 on non-speculative replicas
+    # and (default) on pre-speculation summaries.
+    spec_accept_rate: float = 0.0
     # [(token path, full cached token length)], hottest first. Tiered
     # replicas publish 3-tuples (token path, cached length, RESIDENT
     # length): resident tokens hit for free, the demoted remainder
@@ -161,6 +167,7 @@ def summarize(engine, replica: str, fleet: str = "fleet", seq: int = 0,
         weight_device_bytes=int(st.get("weight_device_bytes", 0)),
         dram_cached_pages=int(st.get("dram_cached_pages", 0)),
         role=str(st.get("role", "mixed")),
+        spec_accept_rate=float(st.get("spec_accept_rate", 0.0)),
         digest=engine.cache_digest(top_k, max_tokens),
     )
 

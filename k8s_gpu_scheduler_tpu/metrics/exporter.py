@@ -347,6 +347,26 @@ PROMOTED_HIT_HISTOGRAM = "tpu_serve_promoted_hit_tokens"
 # changes after engine birth, so no stale one-hot cleanup is needed.
 TP_COMBINE_INFO = "tpu_serve_tp_combine"
 
+# Adaptive speculative gamma (serving spec_adaptive=True, pool_metrics()
+# "spec_gamma_agg": {"min","mean","max"}): the effective verify-window
+# spread across active slots under {slot_agg=} — one gauge, three
+# aggregate series, the PromQL idiom for a small per-slot distribution
+# whose slot cardinality must not leak into the exposition. Non-adaptive
+# speculative engines publish the flat configured gamma on all three.
+SPEC_GAMMA_GAUGE = "tpu_serve_spec_gamma"
+
+# Per-dispatch speculative accept rates (pool_metrics()
+# "spec_accept_batch", drained in the same _obs_mu snapshot as the phase
+# batch — the torn-read rule), observed under {proposer=} so a fleet
+# mixing bigram/ngram/draft replicas can compare sources side by side.
+# Rate buckets are uniform in [0, 1]; the _sum/_count ratio is the mean
+# accept rate the cumulative gauge also carries. Registered lazily only
+# when a batch is present — non-speculative exposition stays
+# byte-identical.
+SPEC_ACCEPT_HISTOGRAM = "tpu_serve_spec_accept"
+SPEC_ACCEPT_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9,
+                       1.0)
+
 
 def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
                         prefix: str = "tpu_serve_",
@@ -410,6 +430,24 @@ def export_serving_pool(registry: "Registry", pool_metrics: Dict[str, float],
             "island weight-combine mode (Megatron-sliced weights), "
             "info-style: 1 under {kind=all_gather|psum|replicated|none}",
         ).set(1.0, kind=str(combine), **labels)
+    gamma_agg = pool_metrics.get("spec_gamma_agg")
+    if gamma_agg:
+        gauge = registry.gauge(
+            SPEC_GAMMA_GAUGE,
+            "effective speculative verify window across active slots "
+            "(adaptive gamma), under {slot_agg=min|mean|max}")
+        for agg, value in gamma_agg.items():
+            gauge.set(float(value), slot_agg=str(agg), **labels)
+    accepts = pool_metrics.get("spec_accept_batch") or ()
+    if accepts:
+        proposer = str(pool_metrics.get("spec_proposer", "unknown"))
+        hist = registry.histogram(
+            SPEC_ACCEPT_HISTOGRAM,
+            "Per-dispatch speculative accept rate (accepted / effective "
+            "proposals), by proposal source",
+            buckets=SPEC_ACCEPT_BUCKETS)
+        for rate in accepts:
+            hist.observe(float(rate), proposer=proposer, **labels)
 
 
 # Decode fused→dense downgrade visibility (models/serving.py

@@ -33,14 +33,20 @@ reserves ceil((prompt + decode rows)/page_size) pages up front, so a
 request in flight can never stall mid-decode waiting for a page another
 stuck request holds (no allocation deadlock), at the cost of eos
 early-stop releasing its unused tail only at finish. In SPECULATIVE mode
-the decode-row term grows by gamma (serving.ContinuousBatcher
-._rows_needed): every verify dispatch writes the full 1+gamma window but
-commits only the accepted prefix, so up to gamma rejected rows overshoot
-the committed ``lens`` — the reservation guarantees those rows land in
-pages THIS slot already owns, which is why rewind is a pure lens clamp:
-no page changes hands, no shared (prefix-cache) page is ever written,
-and the overshoot pages return through the ordinary ``free`` at finish
-like any reservation slack. Free is immediate and exact — the
+the decode-row term grows by the verify-window overshoot
+(serving.ContinuousBatcher ._rows_needed/_spec_overshoot): every verify
+dispatch writes up to its effective window past the committed ``lens``
+but commits only the accepted prefix, so the rejected rows overshoot it
+— the reservation guarantees ACCEPTED rows land in pages THIS slot
+already owns, which is why rewind is a pure lens clamp: no page changes
+hands, no shared (prefix-cache) page is ever written, and the overshoot
+pages return through the ordinary ``free`` at finish like any
+reservation slack. Under ADAPTIVE gamma the overshoot term is sized per
+request from the fleet accept-rate EMA and PINNED at submit
+(``_spec_reserve`` — it rides the snapshot), and the per-dispatch
+effective window is capped at that pin: low-accept traffic stops
+hoarding overshoot pages it never lands, without the reservation
+invariant ever weakening. Free is immediate and exact — the
 fragmentation the contiguous cursor design pays (stale epochs,
 bucket-ladder re-dispatch, roll stalls) simply has no analog here.
 """

@@ -1,0 +1,294 @@
+"""Pluggable speculative-proposal sources for the paged batcher.
+
+The serving engine's verify/accept/rewind machinery never cared WHERE
+proposals come from — the verify dispatch takes a [n_slots, gamma]
+token window and commits the accepted prefix — but until this module
+the proposal source was hard-wired to the host-mirror bigram lookup
+inside ``ContinuousBatcher._propose``. This module makes the source a
+constructor argument (``ContinuousBatcher(speculative=True,
+proposer=...)``) behind one small protocol:
+
+- :class:`BigramProposer` — the extracted prompt-lookup bigram rule
+  (latest bigram match over prompt + committed stream, served by an
+  incremental bigram → latest-position index). The DEFAULT: engines
+  built without an explicit proposer behave exactly as before.
+- :class:`NgramProposer` — the same deferred-tail incremental index
+  generalized to (n-1)-token context matches; longer contexts trade
+  match frequency for match precision on structured text.
+- :class:`DraftModelProposer` — a small ``LlamaConfig`` draft model
+  scored in ONE jitted dispatch batched over all active slots per
+  verify step (the gamma autoregressive draft steps unroll inside the
+  program, so the host pays one tunnel round trip, not gamma). It is
+  the one DISTRIBUTIONAL proposer: it returns the per-position draft
+  distributions q it actually sampled from, and the engine's
+  rejection-sampling verify then applies the full
+  ``min(1, p/q)`` accept + ``max(0, p-q)`` residual-resample rule.
+
+Rejection-sampling contract (Leviathan et al. 2023; Chen et al. 2023):
+a proposer either samples proposal i from an explicit distribution
+q_i — ``distributional = True``, ``propose_batch`` returns ``(props,
+q)`` — or proposes deterministically, which is the q = delta(prop)
+special case: the accept probability ``min(1, p_i/q_i)`` collapses to
+``p_i[prop_i]`` and the residual to p with the proposed token zeroed.
+Both cases leave the emitted stream distributed EXACTLY as the target
+sampler (models/serving.py ``_verify_chunk_paged_fn``); greedy engines
+(temperature == 0) reduce to exact-match acceptance either way.
+
+Determinism: proposers are part of the seeded-replay plane
+(graftcheck pass 12 lints this file). Host-mirror proposers are pure
+functions of the committed streams; the draft proposer derives all of
+its sampling randomness on device from the engine's dispatch counter
+(``fold_in`` chains, the ``_decode_chunk_paged_fn`` convention), so
+replaying the same submissions yields the same proposals.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Protocol, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import LlamaConfig, forward
+
+_NEG_INF = -1e30
+
+
+class SlotView:
+    """What a proposer may read about one active slot: the committed
+    stream (prompt + emitted tokens) and the identity needed to keep
+    incremental per-slot state coherent across slot reuse."""
+
+    __slots__ = ("slot", "rid", "prompt", "out")
+
+    def __init__(self, slot: int, rid: int,
+                 prompt: Sequence[int], out: Sequence[int]) -> None:
+        self.slot = int(slot)
+        self.rid = int(rid)
+        self.prompt = prompt
+        self.out = out
+
+
+class Proposer(Protocol):
+    """Proposal source protocol. ``name`` labels the accept-rate
+    metrics; ``distributional`` tells the engine whether proposals come
+    with explicit q distributions (full min(1, p/q) rejection) or are
+    deterministic (delta-q); ``batched`` selects the engine's dispatch
+    style — per-slot calls with per-request error isolation, or one
+    batched call per verify step."""
+
+    name: str
+    distributional: bool
+    batched: bool
+
+    def propose(self, view: SlotView, gamma: int) -> List[int]:
+        """gamma proposal tokens for one slot (``batched = False``)."""
+        ...
+
+    def propose_batch(
+        self, views: Sequence[SlotView], gamma: int, seed: int,
+    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """(props [len(views), gamma] int32, q [len(views), gamma,
+        vocab] float32 or None) for all active slots at once
+        (``batched = True``). ``seed`` is the engine's dispatch
+        counter — the only randomness source a proposer may use."""
+        ...
+
+    def drop(self, slot: int) -> None:
+        """Forget per-slot state (slot freed, failed, or shed)."""
+        ...
+
+
+class NgramProposer:
+    """Prompt-lookup proposals by LATEST (n-1)-token context match
+    against the slot's committed stream — ``generate_speculative``'s
+    rule on a host mirror, generalized from bigrams to n-grams.
+
+    The match is served by a per-slot incremental context → latest-
+    position index with the DEFERRED-TAIL invariant: the n-gram ending
+    at the current tail is recorded only once a token lands after it,
+    so a lookup of the tail context always answers with the latest
+    *previous* occurrence — steady-state cost O(tokens committed since
+    the last dispatch) = O(gamma) per slot, and the index rebuilds from
+    the prompt when the slot changes hands (O(prompt), once per
+    admission). No match → zeros; garbage guesses are simply rejected
+    by the verify, costing nothing beyond the window the dispatch pads
+    to anyway."""
+
+    distributional = False
+    batched = False
+
+    def __init__(self, n: int = 3) -> None:
+        if n < 2:
+            raise ValueError(f"n-gram proposer needs n >= 2, got {n}")
+        self.n = int(n)
+        self.name = f"{self.n}gram"
+        # slot -> (rid, hist list, context-tuple -> latest tail index)
+        self._mirror: Dict[int, Tuple[int, list, dict]] = {}
+
+    def _append(self, hist: list, idx: dict, tk: int) -> None:
+        if len(hist) >= self.n:
+            idx[tuple(hist[-self.n:])] = len(hist) - 1
+        hist.append(tk)
+
+    def propose(self, view: SlotView, gamma: int) -> List[int]:
+        mirror = self._mirror.get(view.slot)
+        if mirror is None or mirror[0] != view.rid:  # slot reassigned
+            mirror = (view.rid, [], {})
+            self._mirror[view.slot] = mirror
+            for tk in view.prompt:
+                self._append(mirror[1], mirror[2], int(tk))
+        _, hist, idx = mirror
+        base = len(view.prompt)
+        for tk in view.out[len(hist) - base:]:
+            self._append(hist, idx, int(tk))
+        if len(hist) < self.n:
+            return [0] * gamma
+        j = idx.get(tuple(hist[-self.n:]))
+        if j is None:
+            return [0] * gamma
+        guess = [int(tk) for tk in hist[j + 1:j + 1 + gamma]]
+        return guess + [0] * (gamma - len(guess))
+
+    def drop(self, slot: int) -> None:
+        self._mirror.pop(slot, None)
+
+
+class BigramProposer(NgramProposer):
+    """The original host-mirror bigram lookup (n = 2) — the default
+    proposer, byte-for-byte the behavior speculative engines had before
+    proposers were pluggable."""
+
+    def __init__(self) -> None:
+        super().__init__(n=2)
+        self.name = "bigram"
+
+
+class DraftModelProposer:
+    """Small-draft-model proposals with explicit q distributions.
+
+    One jitted program per verify step, batched over ALL active slots:
+    each slot's recent committed context (right-padded to a static
+    ``ctx`` window) runs through the draft ``forward`` and the gamma
+    autoregressive draft steps unroll INSIDE the program — per-slot
+    fold_in'd keys sample each proposal from the draft's temperature/
+    top-k distribution, and exactly those distributions return as q, so
+    the engine's ``min(1, p/q)`` accept + residual resample is correct
+    by construction. A draft sharing the target's weights and sampler
+    settings yields q == p — every proposal accepts (the full-accept
+    identity cell in tests/test_speculative_batcher.py).
+
+    The draft should be MUCH smaller than the target (the whole point:
+    gamma cheap forwards buy one expensive verify), share its vocab,
+    and run greedy (``temperature=0`` → delta-q argmax proposals) or
+    match the target's sampler. Context is truncated to the last
+    ``ctx`` tokens — q is still exact (it is whatever the draft
+    actually sampled from), truncation only costs accept rate."""
+
+    distributional = True
+    batched = True
+    name = "draft"
+
+    def __init__(self, cfg: LlamaConfig, params: Dict,
+                 temperature: float = 0.0, top_k: int = 0,
+                 ctx: int = 32) -> None:
+        if ctx < 1:
+            raise ValueError(f"draft context must be >= 1, got {ctx}")
+        self.cfg = cfg
+        self.params = params
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.ctx = int(min(ctx, cfg.max_seq))
+        self._jit: Dict[int, object] = {}      # gamma -> compiled program
+
+    def _program(self, gamma: int):
+        """Build (once per gamma) the jitted batched draft program:
+        (params, ctx_tokens [B, ctx+gamma], lens [B], seed) →
+        (props [B, gamma] int32, q [B, gamma, vocab] float32)."""
+        fn = self._jit.get(gamma)
+        if fn is not None:
+            return fn
+        cfg, temp, tk = self.cfg, self.temperature, self.top_k
+        span = self.ctx + gamma
+
+        def program(params, tokens, lens, seed):
+            base = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+            keys = jax.vmap(
+                lambda s: jax.random.fold_in(base, s)
+            )(jnp.arange(tokens.shape[0]))
+            pos = jnp.arange(span)[None, :]
+            props, qs = [], []
+            for i in range(gamma):
+                logits = forward(params, tokens, cfg)   # [B, span, V]
+                row = jnp.take_along_axis(
+                    logits, (lens + i - 1)[:, None, None], axis=1
+                )[:, 0].astype(jnp.float32)             # [B, V]
+                if temp <= 0.0:
+                    nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)
+                    q = jax.nn.one_hot(nxt, cfg.vocab,
+                                       dtype=jnp.float32)
+                else:
+                    adj = row / temp
+                    if tk > 0:
+                        kth = jax.lax.top_k(adj, tk)[0][..., -1:]
+                        adj = jnp.where(adj < kth, _NEG_INF, adj)
+                    q = jax.nn.softmax(adj, axis=-1)
+                    step_keys = jax.vmap(
+                        lambda k: jax.random.fold_in(k, i))(keys)
+                    nxt = jax.vmap(jax.random.categorical)(
+                        step_keys, adj).astype(jnp.int32)
+                props.append(nxt)
+                qs.append(q)
+                tokens = jnp.where(pos == (lens + i)[:, None],
+                                   nxt[:, None], tokens)
+            return (jnp.stack(props, axis=1),
+                    jnp.stack(qs, axis=1))
+
+        fn = jax.jit(program)
+        self._jit[gamma] = fn
+        return fn
+
+    def propose_batch(
+        self, views: Sequence[SlotView], gamma: int, seed: int,
+    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        B = len(views)
+        span = self.ctx + gamma
+        tokens = np.zeros((B, span), np.int32)
+        lens = np.zeros((B,), np.int32)
+        for i, v in enumerate(views):
+            stream = list(v.prompt) + list(v.out)
+            tail = stream[-self.ctx:]
+            tokens[i, :len(tail)] = tail
+            lens[i] = len(tail)
+        props, q = self._program(gamma)(
+            self.params, jnp.asarray(tokens), jnp.asarray(lens),
+            jnp.int32(seed))
+        # graftcheck: ignore[host-sync] — sanctioned: proposal tokens gate the verify dispatch's window operand (content-dependent by nature, the spec step's one-readback contract; q rides the same transfer)
+        props, q = jax.device_get((props, q))
+        return np.asarray(props, np.int32), np.asarray(q, np.float32)
+
+    def drop(self, slot: int) -> None:  # stateless per slot
+        pass
+
+
+def resolve_proposer(spec) -> "Proposer":
+    """Constructor-argument sugar: None → the historical bigram
+    default; "bigram"/"ngram"/"ngram:N" → host-mirror proposers; a
+    Proposer instance passes through (the only way to get a draft
+    proposer — it needs weights)."""
+    if spec is None or spec == "bigram":
+        return BigramProposer()
+    if isinstance(spec, str):
+        if spec == "ngram":
+            return NgramProposer()
+        if spec.startswith("ngram:"):
+            return NgramProposer(int(spec.split(":", 1)[1]))
+        raise ValueError(
+            f"unknown proposer {spec!r}: expected 'bigram', 'ngram', "
+            f"'ngram:N', or a Proposer instance")
+    for attr in ("name", "distributional", "batched", "drop"):
+        if not hasattr(spec, attr):
+            raise ValueError(
+                f"proposer {spec!r} does not implement the Proposer "
+                f"protocol (missing {attr!r})")
+    return spec

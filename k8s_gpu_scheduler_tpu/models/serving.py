@@ -59,6 +59,7 @@ the recommender's QPS predictions.
 """
 from __future__ import annotations
 
+import math
 import threading
 from collections import OrderedDict, deque
 from functools import partial
@@ -86,9 +87,18 @@ from ..testing.faults import Preempted
 from .llama import LlamaConfig, _constrain, mlp_sublayer
 from .paging import NULL_PAGE, HostTierStore, PageAllocator
 from .prefix_cache import PrefixCache
+from .proposers import SlotView, resolve_proposer
 from .snapshot import ServingSnapshot, SnapshotError, check_fingerprint
 
 _NEG_INF = -1e30
+
+# Adaptive-gamma accept-rate smoothing: the per-request EMA reacts fast
+# (a request's self-repetition regime shifts within tens of tokens); the
+# fleet EMA — which seeds new requests AND sizes their pinned page
+# reservation — moves slowly so one pathological stream cannot whipsaw
+# admission math.
+_SPEC_EMA_ALPHA = 0.3
+_SPEC_FLEET_ALPHA = 0.05
 
 # Cache layout [L, B, S, Hkv, hd]: batch over (dp, fsdp), kv heads over tp.
 CACHE_SPEC = P(None, ("dp", "fsdp"), None, "tp", None)
@@ -351,15 +361,25 @@ def make_server_step(cfg: LlamaConfig, mesh: Optional[Mesh], max_new: int,
 def generate_speculative(
     params: Dict, prompt: jax.Array, cfg: LlamaConfig, max_new: int,
     gamma: int = 4, max_len: Optional[int] = None,
+    temperature: float = 0.0, top_k: int = 0, seed: int = 0,
 ) -> jax.Array:
-    """Greedy decode with PROMPT-LOOKUP speculation (n-gram speculative
+    """Decode with PROMPT-LOOKUP speculation (n-gram speculative
     decoding, draft-model-free): each iteration proposes ``gamma`` tokens
-    by bigram match against the sequence so far, verifies them in ONE
-    (1+gamma)-token forward, and accepts the longest prefix agreeing with
-    greedy argmax — plus the model's own next token at the first
+    by bigram match against the sequence so far and verifies them in ONE
+    (1+gamma)-token forward.
+
+    ``temperature == 0`` (default) accepts the longest prefix agreeing
+    with greedy argmax — plus the model's own next token at the first
     disagreement. Output matches ``generate`` (acceptance is exact-match
     against the verify pass's own argmax; the only divergence source is a
-    float near-tie between the differently-shaped passes); text with
+    float near-tie between the differently-shaped passes). ``temperature
+    > 0`` runs SPECULATIVE-SAMPLING REJECTION (Leviathan et al. 2023) in
+    its deterministic-proposer (delta-q) form: proposal i accepts with
+    prob p_i[prop_i] under the temperature/top-k target distribution, the
+    first rejection resamples from p with the proposed token zeroed, and
+    a full accept draws the bonus token from p_gamma — the emitted stream
+    is distributed exactly as the target sampler's, same rule as the
+    paged batcher's verify branch. Either way text with
     self-repetition (code, long documents) decodes up to gamma+1 tokens
     per model pass, and pathological inputs degrade to one token per
     pass, never below.
@@ -393,9 +413,17 @@ def generate_speculative(
     seq = jnp.zeros((1, S_buf), jnp.int32)
     seq = jax.lax.dynamic_update_slice(seq, prompt.astype(jnp.int32), (0, 0))
 
+    sampled = temperature > 0.0
+    base_key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+
     cache = init_cache(cfg, 1, max_len)
     logits, cache = forward_with_cache(params, prompt, cfg, cache)
-    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    if sampled:
+        first = _sample_tokens(logits[:, -1],
+                               jax.random.fold_in(base_key, t_prompt),
+                               temperature, top_k).astype(jnp.int32)
+    else:
+        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
     seq = jax.lax.dynamic_update_slice(seq, first[:, None], (0, t_prompt))
     # Invariant: seq[:, :n] are decided tokens; cache holds K/V for
     # seq[:, :n-1] (the newest token is fed to the next forward).
@@ -422,15 +450,44 @@ def generate_speculative(
         x = jnp.concatenate([last, prop], axis=1)    # [1, 1+gamma]
         logits, cache = forward_with_cache(params, x, cfg, cache,
                                            verify=True)
-        greedy = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # [1+gamma]
-        accept = jnp.cumprod(
-            (prop[0] == greedy[:-1]).astype(jnp.int32)).sum()
-        # Emit the accepted guesses plus the model's own continuation at
-        # the first miss: exactly greedy[0..accept] — a fixed-width write
-        # of the whole greedy vector, advancing n by only accept+1, keeps
-        # shapes static (rows past n+accept are scratch, overwritten
-        # before ever being read).
-        seq = jax.lax.dynamic_update_slice(seq, greedy[None, :], (0, n))
+        if sampled:
+            # Delta-q rejection against the temperature/top-k target law
+            # — the B=1 mirror of _verify_chunk_paged_fn's sampling
+            # branch, keyed by the decided-token count n (replay-stable:
+            # the same seed and submissions re-draw the same uniforms).
+            adj = logits[0].astype(jnp.float32) / temperature
+            if top_k > 0:
+                kth = jax.lax.top_k(adj, top_k)[0][..., -1:]
+                adj = jnp.where(adj < kth, _NEG_INF, adj)
+            p = jax.nn.softmax(adj, axis=-1)         # [1+gamma, V]
+            kn = jax.random.fold_in(base_key, n)
+            u = jax.random.uniform(jax.random.fold_in(kn, 0), (gamma,))
+            p_prop = jnp.take_along_axis(
+                p[:gamma], prop[0][:, None], axis=-1)[:, 0]
+            accept = jnp.cumprod(
+                (u < p_prop).astype(jnp.int32)).sum()
+            p_at = p[accept]
+            rej = prop[0][jnp.minimum(accept, gamma - 1)]
+            resid = p_at * (1.0 - jax.nn.one_hot(rej, p.shape[-1],
+                                                 dtype=p_at.dtype))
+            dist = jnp.where(accept >= gamma, p_at, resid)
+            corr = jax.random.categorical(
+                jax.random.fold_in(kn, 1),
+                jnp.log(dist + 1e-20)).astype(jnp.int32)
+            prop_pad = jnp.concatenate([prop[0], prop[0][-1:]])
+            toks = jnp.where(jnp.arange(1 + gamma) == accept,
+                             corr, prop_pad)
+        else:
+            greedy = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+            accept = jnp.cumprod(
+                (prop[0] == greedy[:-1]).astype(jnp.int32)).sum()
+            toks = greedy
+        # Emit the accepted guesses plus the continuation at the first
+        # miss: exactly toks[0..accept] — a fixed-width write of the
+        # whole vector, advancing n by only accept+1, keeps shapes
+        # static (rows past n+accept are scratch, overwritten before
+        # ever being read).
+        seq = jax.lax.dynamic_update_slice(seq, toks[None, :], (0, n))
         # Rewind: keep K/V only for the accepted prefix. Stale rows in
         # (n+accept-1, n+gamma-1] fall inside the next verify's write
         # window starting at the rewound len.
@@ -448,13 +505,16 @@ def generate_speculative(
 
 def make_speculative_server_step(cfg: LlamaConfig, max_new: int,
                                  gamma: int = 4,
-                                 max_len: Optional[int] = None):
+                                 max_len: Optional[int] = None,
+                                 temperature: float = 0.0,
+                                 top_k: int = 0, seed: int = 0):
     """Jitted handler: (params, prompt [1, Tp]) → [1, max_new] — the
     make_server_step analog for the speculative path (one compiled program
     per prompt length; eager calls would pay per-op dispatch under the
     ~100 ms tunnel round trip)."""
     fn = partial(generate_speculative, cfg=cfg, max_new=max_new,
-                 gamma=gamma, max_len=max_len)
+                 gamma=gamma, max_len=max_len, temperature=temperature,
+                 top_k=top_k, seed=seed)
     return jax.jit(fn)
 
 
@@ -1000,15 +1060,45 @@ def _decode_chunk_paged_fn(params, cfg: LlamaConfig, chunk: int,
 
 def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
                            page_size: int, k, v, table, lens, last, props,
-                           active, k_s=None, v_s=None, tp_axis=None,
+                           active, seed=0, eff=None, q=None,
+                           temperature: float = 0.0, top_k: int = 0,
+                           k_s=None, v_s=None, tp_axis=None,
                            tp: int = 1, wsharded: bool = False,
                            combine: str = "all_gather"):
     """One batched speculative VERIFY dispatch over every slot of the
     paged pool: score the t = 1+gamma window [last, props...] of each
-    active slot in a single forward, accept the longest proposal prefix
-    agreeing with the verify pass's own greedy argmax, and commit exactly
-    the accepted tokens — the multi-slot analog of generate_speculative's
-    loop body, with pages as the rewind unit.
+    active slot in a single forward, accept the longest valid proposal
+    prefix, and commit exactly the accepted tokens plus one model token
+    — the multi-slot analog of generate_speculative's loop body, with
+    pages as the rewind unit.
+
+    The accept rule branches AT TRACE TIME on ``temperature`` (a Python
+    constant, like every sampling knob in this engine):
+
+    - ``temperature == 0`` — exact-match: the longest proposal prefix
+      agreeing with the verify pass's own greedy argmax, byte-identical
+      to the pre-sampling speculative path (no PRNG touches the trace).
+    - ``temperature > 0`` — SPECULATIVE-SAMPLING REJECTION (Leviathan
+      et al. 2023; Chen et al. 2023): per-row target distributions p_i
+      come from the verify logits through the ``_sample_tokens``
+      temperature/top-k machinery; per-slot keys fold from ``seed``
+      (the dispatch counter — no PRNG state crosses the tunnel).
+      Proposal i accepts with prob ``min(1, p_i[prop_i]/q_i[prop_i])``
+      — ``q`` None means a DETERMINISTIC proposer, the q = delta(prop)
+      special case where the accept prob collapses to ``p_i[prop_i]``.
+      On the first rejection the committed continuation resamples from
+      the renormalized residual ``max(0, p - q)`` (delta-q: p with the
+      proposed token zeroed); on full acceptance it samples the BONUS
+      token from p at the position past the window. Emitted tokens are
+      therefore distributed exactly as the target sampler's — the
+      tokens-per-dispatch multiplier with no distribution drift.
+
+    ``eff`` [B] (None = the full gamma) is the per-slot EFFECTIVE
+    window: proposal rows at positions >= eff are masked out of
+    acceptance (never accepted, their writes rewound like any
+    rejection), which is how adaptive per-slot gamma keeps the dispatch
+    shape static at 1+gamma while low-accept slots stop paying for —
+    and stop reserving — overshoot they never land.
 
     The window's K/V rows scatter at logical rows lens..lens+gamma of
     each slot BEFORE attention (the same write-then-attend order as the
@@ -1017,8 +1107,8 @@ def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
     gathered dense verify reference. ``lens`` then advances by the TRACED
     commit length accept+1 only: the up-to-gamma rejected overshoot rows
     sit above the new lens — inside the slot's own reserved pages, since
-    admission reserves the gamma overshoot too (_rows_needed) — masked by
-    every later read until the next verify window overwrites them
+    admission reserves the overshoot window too (_rows_needed) — masked
+    by every later read until the next verify window overwrites them
     (new window = rows lens'..lens'+gamma ⊇ the stale extent). That lens
     clamp IS the rewind: no page moves, no shared prefix page is ever
     touched (writes land at rows >= lens >= hit_len — the copy-on-write
@@ -1026,18 +1116,18 @@ def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
     alias scenario).
 
     Inactive slots redirect their window writes to the null page and
-    carry lens/last through. Greedy-only by construction (acceptance is
-    exact-match against argmax; the batcher rejects speculative+sampling
-    at __init__), so no PRNG state rides along. Returns the donated pool
-    /scale/table chain plus per-slot ``emitted`` [B, 1+gamma] (-1 past
-    the commit length and for inactive slots) and ``accepts`` [B] (the
-    number of PROPOSALS accepted, 0..gamma).
+    carry lens/last through. Returns the donated pool/scale/table chain
+    plus per-slot ``emitted`` [B, 1+gamma] (-1 past the commit length
+    and for inactive slots) and ``accepts`` [B] (the number of
+    PROPOSALS accepted, 0..gamma).
 
     ``tp_axis`` non-None = shard_map island mode, exactly the decode
     chunk's contract (_decode_chunk_paged_fn): pool/scales sharded on kv
     heads, full projections sliced to this shard's head family, kernel
     body unchanged on local shapes, attention heads ``all_gather``ed back
-    (exact combine — byte identity), accept/commit math replicated."""
+    (exact combine — byte identity), accept/resample math replicated
+    (per-slot keys fold from the replicated seed, so every shard draws
+    the same uniforms)."""
     quant = k_s is not None
     B = last.shape[0]
     t = 1 + gamma
@@ -1106,15 +1196,79 @@ def _verify_chunk_paged_fn(params, cfg: LlamaConfig, gamma: int,
         block, x, (params["blocks"], k, v, k_s, v_s))
     x = rms_norm(x, params["final_norm"])
     logits = qdot(x, params["lm_head"]).astype(jnp.float32)  # [B, t, vocab]
-    greedy = jnp.argmax(logits, axis=-1).astype(last.dtype)  # [B, t]
-    # Longest agreeing proposal prefix, exactly generate_speculative's
-    # accept rule, vectorized over slots.
-    hits = (window[:, 1:] == greedy[:, :-1]).astype(jnp.int32)
-    accepts = jnp.cumprod(hits, axis=1).sum(axis=1)          # [B] 0..gamma
+    eff_i = (jnp.full((B,), gamma, jnp.int32) if eff is None
+             else jnp.asarray(eff, jnp.int32))
+    pos_ok = jnp.arange(gamma)[None, :] < eff_i[:, None]     # [B, gamma]
+    if temperature <= 0.0:
+        # Exact-match acceptance against the verify pass's own argmax —
+        # generate_speculative's rule, vectorized over slots. With the
+        # full effective window this is byte-identical to the
+        # pre-sampling path (pos_ok is all-true and folds away).
+        greedy = jnp.argmax(logits, axis=-1).astype(last.dtype)  # [B, t]
+        hits = ((window[:, 1:] == greedy[:, :-1])
+                & pos_ok).astype(jnp.int32)
+        accepts = jnp.cumprod(hits, axis=1).sum(axis=1)      # [B] 0..gamma
+        toks = greedy
+    else:
+        # Rejection sampling. Target distributions through the same
+        # temperature/top-k shaping _sample_tokens applies, normalized:
+        # p[:, i] is the sampler's next-token law after window[:, :i+1].
+        adj = logits / temperature
+        if top_k > 0:
+            kth = jax.lax.top_k(adj, top_k)[0][..., -1:]
+            adj = jnp.where(adj < kth, _NEG_INF, adj)
+        p = jax.nn.softmax(adj, axis=-1)                     # [B, t, V]
+        base_key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+        keys = jax.vmap(
+            lambda s: jax.random.fold_in(base_key, s))(row_ids)
+        u = jax.vmap(lambda kk: jax.random.uniform(kk, (gamma,)))(
+            jax.vmap(lambda kk: jax.random.fold_in(kk, 0))(keys))
+        prop_t = window[:, 1:]                               # [B, gamma]
+        p_prop = jnp.take_along_axis(
+            p[:, :gamma], prop_t[..., None], axis=-1)[..., 0]
+        if q is None:
+            # Deterministic proposer: q = delta(prop), accept with
+            # prob p itself.
+            a_prob = p_prop
+        else:
+            q_prop = jnp.take_along_axis(
+                jnp.asarray(q, jnp.float32), prop_t[..., None],
+                axis=-1)[..., 0]
+            a_prob = jnp.minimum(1.0, p_prop / jnp.maximum(q_prop, 1e-20))
+        acc = (pos_ok & (u < a_prob)).astype(jnp.int32)
+        accepts = jnp.cumprod(acc, axis=1).sum(axis=1)       # [B] 0..gamma
+        # Continuation token at position `accepts`: the BONUS draw from
+        # p itself on full acceptance (accepts == eff — including
+        # eff == 0, where this is exactly plain sampled decode), else
+        # the residual max(0, p - q) renormalized (delta-q: p with the
+        # rejected proposal zeroed; categorical-over-log normalizes).
+        p_at = jnp.take_along_axis(
+            p, accepts[:, None, None], axis=1)[:, 0]         # [B, V]
+        safe = jnp.minimum(accepts, gamma - 1)
+        if q is None:
+            rej = jnp.take_along_axis(prop_t, safe[:, None], axis=1)[:, 0]
+            resid = p_at * (1.0 - jax.nn.one_hot(
+                rej, p.shape[-1], dtype=p_at.dtype))
+        else:
+            q_at = jnp.take_along_axis(
+                jnp.asarray(q, jnp.float32), safe[:, None, None],
+                axis=1)[:, 0]
+            resid = jnp.maximum(p_at - q_at, 0.0)
+        full_acc = accepts >= eff_i
+        corr_dist = jnp.where(full_acc[:, None], p_at, resid)
+        corr = jax.vmap(jax.random.categorical)(
+            jax.vmap(lambda kk: jax.random.fold_in(kk, 1))(keys),
+            jnp.log(corr_dist + 1e-20)).astype(last.dtype)
+        # Committed tokens: the accepted proposals verbatim, then the
+        # resampled/bonus continuation at position `accepts`.
+        idx_t = jnp.arange(t)[None, :]
+        prop_pad = jnp.concatenate([prop_t, prop_t[:, -1:]], axis=1)
+        toks = jnp.where(idx_t == accepts[:, None], corr[:, None],
+                         prop_pad).astype(last.dtype)
     commit = jnp.arange(t)[None, :] <= accepts[:, None]      # [B, t]
-    emitted = jnp.where(commit & active_i[:, None], greedy,
-                        jnp.full_like(greedy, -1))
-    new_last = jnp.take_along_axis(greedy, accepts[:, None], axis=1)[:, 0]
+    emitted = jnp.where(commit & active_i[:, None], toks,
+                        jnp.full_like(toks, -1))
+    new_last = jnp.take_along_axis(toks, accepts[:, None], axis=1)[:, 0]
     last = jnp.where(active_i, new_last, last)
     lens = lens + jnp.where(active_i, accepts + 1, 0).astype(lens.dtype)
     accepts = jnp.where(active_i, accepts, 0)
@@ -1470,6 +1624,7 @@ class ContinuousBatcher:
                  prefill_chunk_tokens: Optional[int] = None,
                  role: str = "mixed",
                  speculative: bool = False, gamma: int = 4,
+                 proposer=None, spec_adaptive: bool = False,
                  prefill_attn: Optional[str] = None,
                  donate_decoded: bool = True,
                  weight_sharding: bool = True,
@@ -1623,18 +1778,21 @@ class ContinuousBatcher:
         self._eos_scanned: Dict[int, int] = {}       # req id -> tokens scanned
         self.spec = bool(speculative)
         self.gamma = int(gamma)
+        self.spec_adaptive = bool(spec_adaptive) and self.spec
         if self.spec:
             if kv_layout != "paged":
                 raise ValueError(
                     "speculative=True requires kv_layout='paged' (rewind "
                     "is a lens clamp inside the slot's own pages)")
-            if self.temperature > 0:
-                raise ValueError(
-                    "speculative decode is greedy-only (acceptance is "
-                    "exact-match against the verify argmax); temperature "
-                    "must be 0")
             if self.gamma < 1:
                 raise ValueError(f"gamma must be >= 1, got {gamma}")
+            # Pluggable proposal source (models/proposers.py): the
+            # historical host-mirror bigram by default. temperature > 0
+            # engines run the verify's speculative-sampling rejection
+            # branch — distributional proposers (draft model) supply
+            # their q for the full min(1, p/q) rule, deterministic ones
+            # are the delta-q special case.
+            self._proposer = resolve_proposer(proposer)
             # Speculation gauges (pool_metrics → tpu_serve_spec_*): how
             # many proposals each verify accepted, tokens committed per
             # active slot per dispatch, and the overshoot rows rewound.
@@ -1644,9 +1802,22 @@ class ContinuousBatcher:
             self._spec_accepted = 0
             self._spec_emitted = 0
             self._spec_rewound = 0
-            # Per-slot proposal mirror: (rid, hist, bigram→latest index),
-            # grown incrementally as tokens commit (see _propose).
-            self._spec_mirror = {}
+            # Per-dispatch accept rates, drained by pool_metrics() into
+            # the proposer-labeled tpu_serve_spec_accept histogram —
+            # bounded drop-oldest like every obs buffer.
+            self._spec_accept_buf: deque = deque(maxlen=4096)
+            # Adaptive per-slot gamma: an accept-rate EMA per request
+            # drives the EFFECTIVE verify window in 0..gamma (dispatch
+            # stays padded to 1+gamma — static shapes — rows >= eff are
+            # masked out of acceptance). _spec_reserve pins, per rid AT
+            # ADMISSION, the overshoot rows its pages were reserved for;
+            # the effective window never exceeds it, so accepted rows
+            # always land inside reserved pages even as the fleet EMA
+            # moves. All three ride ServingSnapshot across drain/absorb.
+            self._spec_ema: Dict[int, float] = {}
+            self._spec_eff_last: Dict[int, int] = {}
+            self._spec_reserve: Dict[int, int] = {}
+            self._spec_fleet_ema = 1.0
         self.S = min(max_len or cfg.max_seq, cfg.max_seq)
         # Multi-chip sharded paged serving: a mesh with a 'tp' axis wraps
         # every paged dispatch (decode chunk / verify window / (tb, hb)
@@ -1937,18 +2108,40 @@ class ContinuousBatcher:
                 # The verify dispatch replaces the decode chunk: one
                 # (1+gamma)-window forward per step instead of `chunk`
                 # single-token ticks; the donation contract is identical
-                # (pool + scales + table consumed every dispatch).
-                self._decode = self._jit_island(
-                    lambda p, k, v, ks, vs, tbl, lens, last, props, active:
-                    _verify_chunk_paged_fn(
-                        p, cfg, gm, ps, k, v, tbl, lens, last, props,
-                        active, k_s=ks, v_s=vs, **tp_kw),
-                    in_specs=(W_, PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_,
-                              RE_),
-                    out_specs=(PS_, PS_, PS_, PS_, RE_, RE_, RE_, RE_,
-                               RE_),
-                    donate=(1, 2, 3, 4, 5),
-                )
+                # (pool + scales + table consumed every dispatch). New
+                # since the sampling branch: seed (dispatch counter —
+                # PRNG derives on device), eff (per-slot effective
+                # windows, = gamma when non-adaptive) and, for
+                # distributional proposers only, the q distributions —
+                # all replicated, none donated, shapes static.
+                if self._proposer.distributional:
+                    self._decode = self._jit_island(
+                        lambda p, k, v, ks, vs, tbl, lens, last, props,
+                        active, seed, eff, q: _verify_chunk_paged_fn(
+                            p, cfg, gm, ps, k, v, tbl, lens, last, props,
+                            active, seed=seed, eff=eff, q=q,
+                            temperature=temp, top_k=tk, k_s=ks, v_s=vs,
+                            **tp_kw),
+                        in_specs=(W_, PS_, PS_, PS_, PS_, RE_, RE_, RE_,
+                                  RE_, RE_, RE_, RE_, RE_),
+                        out_specs=(PS_, PS_, PS_, PS_, RE_, RE_, RE_,
+                                   RE_, RE_),
+                        donate=(1, 2, 3, 4, 5),
+                    )
+                else:
+                    self._decode = self._jit_island(
+                        lambda p, k, v, ks, vs, tbl, lens, last, props,
+                        active, seed, eff: _verify_chunk_paged_fn(
+                            p, cfg, gm, ps, k, v, tbl, lens, last, props,
+                            active, seed=seed, eff=eff,
+                            temperature=temp, top_k=tk, k_s=ks, v_s=vs,
+                            **tp_kw),
+                        in_specs=(W_, PS_, PS_, PS_, PS_, RE_, RE_, RE_,
+                                  RE_, RE_, RE_, RE_),
+                        out_specs=(PS_, PS_, PS_, PS_, RE_, RE_, RE_,
+                                   RE_, RE_),
+                        donate=(1, 2, 3, 4, 5),
+                    )
             else:
                 self._decode = self._jit_island(
                     lambda p, k, v, ks, vs, tbl, lens, last, active, seed:
@@ -2178,6 +2371,14 @@ class ContinuousBatcher:
         self._next_id += 1
         self._budget[req_id] = max_new
         self._out[req_id] = []
+        if self.spec:
+            # Pin the overshoot window this request's pages are reserved
+            # for AT SUBMIT TIME: the adaptive effective window may
+            # never exceed it (accepted rows must stay inside reserved
+            # pages), and admission math below must keep quoting the
+            # same figure across retries even as the fleet EMA moves.
+            self._spec_reserve[req_id] = self._spec_overshoot()
+            self._spec_ema[req_id] = self._spec_fleet_ema
         self._arrival[req_id] = self._clock.monotonic()
         if trace_id is not None:
             self._rid_label[req_id] = str(trace_id)
@@ -2188,18 +2389,37 @@ class ContinuousBatcher:
     def pending(self) -> int:
         return len(self._queue) + len(self._slot_req)
 
-    def _rows_needed(self, budget: int) -> int:
+    def _spec_overshoot(self, rid: Optional[int] = None) -> int:
+        """Overshoot rows to reserve beyond the committed stream: the
+        full gamma window normally; under adaptive gamma, the request's
+        PINNED reservation (set at submit from the fleet accept-rate
+        EMA, never revised — admission math must be stable per request),
+        or the current fleet estimate for a request not yet pinned. The
+        effective verify window is capped at this figure, so every
+        ACCEPTED row provably lands inside reserved pages; rejected
+        overshoot rows beyond it spill harmlessly onto rows the write
+        scatter clamps inside the slot's last reserved block or the
+        shared null page, and the lens clamp rewinds them either way."""
+        if not self.spec_adaptive:
+            return self.gamma
+        if rid is not None and rid in self._spec_reserve:
+            return self._spec_reserve[rid]
+        est = int(math.ceil(self._spec_fleet_ema * self.gamma))
+        return max(1, min(self.gamma, est))
+
+    def _rows_needed(self, budget: int, rid: Optional[int] = None) -> int:
         """Worst-case cursor rows a request still needs: its remaining
         decode steps, rounded up to whole chunks (the shared cursor
         advances chunk rows per dispatch). Speculative mode commits at
         most one row per emitted token (budget - 1 rows) but each verify
-        writes the full 1+gamma window, so up to gamma rejected overshoot
-        rows can sit above the last committed lens — reserving them here
-        is what makes rewind a free lens clamp inside the slot's own
-        pages (never a shared prefix page, never an allocation)."""
+        writes up to its effective window past the last committed lens,
+        so up to _spec_overshoot rejected rows can sit above it —
+        reserving them here is what makes rewind a free lens clamp
+        inside the slot's own pages (never a shared prefix page, never
+        an allocation)."""
         steps = max(0, budget - 1)                   # first token = prefill
         if self.spec:
-            return steps + self.gamma
+            return steps + self._spec_overshoot(rid)
         return -(-steps // self.chunk) * self.chunk
 
     @staticmethod
@@ -2412,15 +2632,18 @@ class ContinuousBatcher:
         return finished
 
     # -- paged step --------------------------------------------------------
-    def _pages_needed(self, prompt_len: int, budget: int) -> int:
+    def _pages_needed(self, prompt_len: int, budget: int,
+                      rid: Optional[int] = None) -> int:
         """Worst-case pages a request can ever touch: its prompt rows plus
         the decode rows — chunk-rounded in plain mode (the device writes
-        whole chunks for active slots), budget + the gamma verify-window
-        overshoot in speculative mode (see _rows_needed for both
+        whole chunks for active slots), budget + the verify-window
+        overshoot in speculative mode (the per-request pinned window
+        under adaptive gamma — see _rows_needed/_spec_overshoot for both
         formulas) — page-granular. Reserved in FULL at admission so a
         request in flight never stalls on allocation (no mid-decode
         deadlock); eos early-stop returns the unused tail at finish."""
-        return -(-(prompt_len + self._rows_needed(budget)) // self.page_size)
+        return -(-(prompt_len + self._rows_needed(budget, rid))
+                 // self.page_size)
 
     def _hb_bucket(self, n_hit_pages: int) -> int:
         """Prefix-table width bucket for a hit of ``n_hit_pages`` pages:
@@ -2587,7 +2810,7 @@ class ContinuousBatcher:
                     self._alloc.retain(hits)
             # Fresh pages: the slot's own reservation PLUS one per
             # demoted hit page to promote into.
-            need = (self._pages_needed(P, self._budget[req_id])
+            need = (self._pages_needed(P, self._budget[req_id], req_id)
                     - len(hits) - len(demoted))
             if self._prefix is not None \
                     and need + len(demoted) > self._alloc.free_count:
@@ -2614,7 +2837,7 @@ class ContinuousBatcher:
                     if alive < len(demoted):
                         del demoted[alive:]
                         need = (self._pages_needed(
-                            P, self._budget[req_id])
+                            P, self._budget[req_id], req_id)
                             - len(hits) - len(demoted))
             pages = self._alloc.alloc(
                 need + len(demoted),
@@ -3073,48 +3296,23 @@ class ContinuousBatcher:
                 faults=self._step_faults)
         return finished
 
-    def _mirror_append(self, hist: list, idx: dict, tk: int) -> None:
-        """Grow a slot's proposal mirror by one committed token, keeping
-        the bigram index's DEFERRED-TAIL invariant: the bigram ending at
-        the current tail is recorded only once a token lands after it, so
-        a lookup of the tail bigram always answers with the latest
-        *previous* occurrence — exactly the `j <= n-2` bound of the
-        linear-scan rule this index replaces."""
-        if len(hist) >= 2:
-            idx[(hist[-2], hist[-1])] = len(hist) - 1
-        hist.append(tk)
-
-    def _propose(self, slot: int, rid: int) -> list:
-        """Prompt-lookup proposal for one slot: gamma tokens guessed by
-        the LATEST bigram match against the slot's committed stream
-        (prompt + emitted tokens — generate_speculative's rule on the
-        host mirror instead of the device buffer). No match → zeros;
-        garbage guesses are simply rejected by the verify, costing
-        nothing beyond the window the dispatch pads to anyway.
-
-        The match is served by a per-slot incremental bigram → latest-
-        position index instead of a backward scan, so steady-state cost
-        is O(tokens committed since the last dispatch) = O(gamma) — a
-        long non-repetitive stream (where speculation pays nothing) no
-        longer inserts an O(history) Python loop between the synchronous
-        verify dispatches. The index rebuilds from the prompt when the
-        slot changes hands (O(prompt), once per admission)."""
-        g = self.gamma
-        mirror = self._spec_mirror.get(slot)
-        if mirror is None or mirror[0] != rid:       # slot reassigned
-            mirror = (rid, [], {})
-            self._spec_mirror[slot] = mirror
-            for tk in self._slot_prompt[slot]:
-                self._mirror_append(mirror[1], mirror[2], int(tk))
-        _, hist, idx = mirror
-        base = len(self._slot_prompt[slot])
-        for tk in self._out[rid][len(hist) - base:]:
-            self._mirror_append(hist, idx, int(tk))
-        j = idx.get((hist[-2], hist[-1]))
-        if j is None:
-            return [0] * g
-        guess = [int(tk) for tk in hist[j + 1:j + 1 + g]]
-        return guess + [0] * (g - len(guess))
+    def _spec_eff_window(self, rid: int) -> int:
+        """Effective verify window for one request THIS dispatch: the
+        full gamma unless adaptive, else the accept-rate EMA's estimate
+        of how many proposals are worth paying for — capped at the
+        request's pinned page reservation (accepted rows must land
+        inside reserved pages) and floored at 0 (a 0 window is plain
+        1-token decode through the same dispatch). A stuck-at-0 window
+        would never observe an accept again, so every 8th dispatch
+        probes with a 1-token window to let bursty self-repetition
+        reopen it."""
+        if not self.spec_adaptive:
+            return self.gamma
+        ema = self._spec_ema.get(rid, self._spec_fleet_ema)
+        w = int(round(ema * self.gamma))
+        if w <= 0 and self._dispatch_no % 8 == 0:
+            w = 1
+        return max(0, min(w, self._spec_reserve.get(rid, self.gamma)))
 
     def _step_spec_paged(self) -> list:
         """Speculative analog of _step_lazy_paged: admit, then ONE
@@ -3150,39 +3348,66 @@ class ContinuousBatcher:
         # slots have no committed stream yet — they sit out the verify
         # (inactive window rows, no proposal, no commit).
         self._flush()
+        self._dispatch_no += 1
         props = np.zeros((self.n_slots, self.gamma), np.int32)
+        views = []
         for slot, rid in list(ready.items()):
             # Per-request error isolation: a poison request (host-side
             # failure building ITS proposal — chaos hook serve.propose,
-            # or a genuine assert in the mirror/bigram code) fails THAT
-            # request with a recorded error; the other slots' proposals,
-            # pages and streams are untouched. Preempted passes through:
-            # it is the whole-engine drain signal, not a request fault.
+            # or a genuine assert in the proposer's mirror code) fails
+            # THAT request with a recorded error; the other slots'
+            # proposals, pages and streams are untouched. Preempted
+            # passes through: it is the whole-engine drain signal, not a
+            # request fault.
             try:
                 if self._faults is not None:
                     self._faults.fire("serve.propose")
-                props[slot] = self._propose(slot, rid)
+                view = SlotView(slot, rid, self._slot_prompt[slot],
+                                self._out[rid])
+                if self._proposer.batched:
+                    views.append(view)
+                else:
+                    props[slot] = self._proposer.propose(view, self.gamma)
             except Preempted:
                 raise
             except Exception as e:  # noqa: BLE001 — isolate the poison request
                 self._fail_request(slot, rid, e)
+        q = None
+        if self._proposer.distributional:
+            q = np.zeros((self.n_slots, self.gamma, self.cfg.vocab),
+                         np.float32)
+        if views:
+            # Batched proposers (draft model) score every surviving slot
+            # in ONE call — a failure here is the draft program itself
+            # breaking, an engine-level fault, not a poison request.
+            p_arr, q_arr = self._proposer.propose_batch(
+                views, self.gamma, self._dispatch_no)
+            for i, vw in enumerate(views):
+                props[vw.slot] = p_arr[i]
+                if q is not None and q_arr is not None:
+                    q[vw.slot] = q_arr[i]
         ready = {s: r for s, r in self._slot_req.items()
                  if s not in self._prefill_pending}
         if not ready:                                # every slot poisoned
             return finished
+        eff = np.zeros((self.n_slots,), np.int32)
+        for slot, rid in ready.items():
+            eff[slot] = self._spec_eff_window(rid)
         active = np.asarray(
             [s in ready for s in range(self.n_slots)])
         table = self._device_table()
-        self._dispatch_no += 1
         t_ver = self._clock.monotonic()
+        dispatch = (self.params, self._k, self._v, self._ks, self._vs,
+                    table, self._lens, self._last, props, active,
+                    np.int32(self._dispatch_no), eff)
+        if self._proposer.distributional:
+            dispatch = dispatch + (q,)
         (self._k, self._v, self._ks, self._vs, self._table, self._lens,
-         self._last, toks, accepts) = self._decode(
-            self.params, self._k, self._v, self._ks, self._vs, table,
-            self._lens, self._last, props, active)
+         self._last, toks, accepts) = self._decode(*dispatch)
         # graftcheck: ignore[host-sync] — sanctioned: speculative scheduling is content-dependent (accept lengths gate budgets and the next proposals), one readback per verify dispatch by design
         toks, accepts = jax.device_get((toks, accepts))
         t_ver1 = self._clock.monotonic()
-        step_used = step_emitted = 0
+        step_used = step_emitted = step_eff = 0
 
         for slot, req_id in list(ready.items()):
             acc = int(accepts[slot])
@@ -3193,11 +3418,32 @@ class ContinuousBatcher:
             # proposals, and those rows are rewound like any rejection —
             # keeps accept_rate and tokens_per_dispatch telling one story.
             used = take - 1
+            eff_i = int(eff[slot])
             step_used += used
             step_emitted += take
+            step_eff += eff_i
+            if self.spec_adaptive:
+                # The EMA observes the rate over the EFFECTIVE window
+                # (rate over a window the dispatch never opened would
+                # drag a good slot down); eff == 0 dispatches carry no
+                # signal either way.
+                if eff_i > 0:
+                    rate = min(used, eff_i) / eff_i
+                    ema = self._spec_ema.get(req_id, self._spec_fleet_ema)
+                    self._spec_ema[req_id] = (
+                        (1.0 - _SPEC_EMA_ALPHA) * ema
+                        + _SPEC_EMA_ALPHA * rate)
+                    self._spec_fleet_ema = (
+                        (1.0 - _SPEC_FLEET_ALPHA) * self._spec_fleet_ema
+                        + _SPEC_FLEET_ALPHA * rate)
+                self._spec_eff_last[req_id] = eff_i
             with self._obs_mu:
                 self._spec_slot_steps += 1
-                self._spec_proposed += self.gamma
+                # proposed = the effective window (== gamma when
+                # non-adaptive); rewound = the PHYSICAL overshoot rows
+                # the lens clamp discards, always measured against the
+                # full padded window the dispatch wrote.
+                self._spec_proposed += eff_i
                 self._spec_accepted += used
                 self._spec_emitted += take
                 self._spec_rewound += self.gamma - used
@@ -3217,6 +3463,10 @@ class ContinuousBatcher:
                 finished.append(req_id)
                 del self._budget[req_id]
                 del self._slot_req[slot]             # slot free NOW
+                self._proposer.drop(slot)
+                self._spec_ema.pop(req_id, None)
+                self._spec_eff_last.pop(req_id, None)
+                self._spec_reserve.pop(req_id, None)
                 t_rp = self._clock.monotonic()
                 # Spec commits land in _out synchronously above, so the
                 # decoded-suffix donation sees the full committed stream.
@@ -3225,9 +3475,11 @@ class ContinuousBatcher:
                 if self._tracer is not None:
                     self._obs_span("reap", t_rp, self._clock.monotonic(),
                                    rid=req_id, slot=slot)
+        n_active = int(active.sum())
         with self._obs_mu:
             self._spec_dispatches += 1
-        n_active = int(active.sum())
+            if step_eff:
+                self._spec_accept_buf.append(step_used / step_eff)
         if self._tracer is not None:
             self._obs_span("verify", t_ver, t_ver1, active=n_active,
                            gamma=self.gamma)
@@ -3237,8 +3489,8 @@ class ContinuousBatcher:
                 wall_ms=round((t_ver1 - t_ver) * 1e3, 3),
                 active=n_active, admitted=self._step_admitted,
                 tokens=step_emitted,
-                accept_rate=(round(step_used / (n_active * self.gamma), 4)
-                             if n_active else 0.0),
+                accept_rate=(round(step_used / step_eff, 4)
+                             if step_eff else 0.0),
                 retired=len(finished),
                 pool_free=self._alloc.free_count,
                 pool_in_use=self._alloc.in_use,
@@ -3282,7 +3534,10 @@ class ContinuousBatcher:
         self._budget.pop(rid, None)
         self._eos_scanned.pop(rid, None)
         if self.spec:
-            self._spec_mirror.pop(slot, None)
+            self._proposer.drop(slot)
+            self._spec_ema.pop(rid, None)
+            self._spec_eff_last.pop(rid, None)
+            self._spec_reserve.pop(rid, None)
         if self.layout == "paged" and slot in self._slot_pages:
             # _free_slot_pages owns the mid-prefill donation cap (it
             # pops _prefill_pending itself); errored streams donate no
@@ -3321,6 +3576,9 @@ class ContinuousBatcher:
                 for d in (self._budget, self._out, self._arrival,
                           self._eos_scanned, self._first_tok):
                     d.pop(req_id, None)
+                if self.spec:
+                    self._spec_ema.pop(req_id, None)
+                    self._spec_reserve.pop(req_id, None)
                 return True
         for slot, rid in self._slot_req.items():
             if rid == req_id:
@@ -3596,6 +3854,17 @@ class ContinuousBatcher:
             flight=([] if partial or self._flight is None
                     else self._flight.to_payload()),
             partial=partial,
+            spec_ema=({int(r): float(v)
+                       for r, v in self._spec_ema.items()
+                       if keep_rid(r)} if self.spec else {}),
+            spec_eff=({int(r): int(v)
+                       for r, v in self._spec_eff_last.items()
+                       if keep_rid(r)} if self.spec else {}),
+            spec_reserve=({int(r): int(v)
+                           for r, v in self._spec_reserve.items()
+                           if keep_rid(r)} if self.spec else {}),
+            spec_fleet_ema=(float(self._spec_fleet_ema)
+                            if self.spec else 1.0),
         )
         snap.validate()
         if partial:
@@ -3619,7 +3888,10 @@ class ContinuousBatcher:
                 self._arrival.pop(rid, None)
                 self._first_tok.pop(rid, None)
                 if self.spec:
-                    self._spec_mirror.pop(slot, None)
+                    self._proposer.drop(slot)
+                    self._spec_ema.pop(rid, None)
+                    self._spec_eff_last.pop(rid, None)
+                    self._spec_reserve.pop(rid, None)
                 # _free_slot_pages pops _prefill_pending itself and caps
                 # a mid-prefill slot's donation at its resident rows.
                 self._free_slot_pages(slot, decoded)
@@ -3744,6 +4016,19 @@ class ContinuousBatcher:
         self._next_id = snap.next_id
         self._eos_scanned = dict(snap.eos_scanned)
         self._skipped_tokens = snap.skipped_tokens
+        if self.spec:
+            # Adaptive-gamma continuity across failover: the restored
+            # streams keep their accept-rate history (no cold-start
+            # re-learning), and — load-bearing — their PINNED page
+            # reservations, which is what lets a restored dispatch's
+            # effective window trust the page math the source engine
+            # admitted under. Old snapshots default these empty; the
+            # effective-window cap then falls back per request to the
+            # full gamma its era reserved.
+            self._spec_ema = dict(snap.spec_ema)
+            self._spec_eff_last = dict(snap.spec_eff)
+            self._spec_reserve = dict(snap.spec_reserve)
+            self._spec_fleet_ema = float(snap.spec_fleet_ema)
         # Slots drained MID-PREFILL (lens < prompt length — chunked
         # prefill, or an absorbed peer's chunk state) re-queue their
         # unprefilled tail; the advance phase resumes them — budgeted
@@ -3911,6 +4196,18 @@ class ContinuousBatcher:
                 self._arrival[new_rid] = arrival[rid]
             if rid in first:
                 self._first_tok[new_rid] = first[rid]
+            if self.spec:
+                # Migrated streams keep their accept-rate history and
+                # pinned reservation under the REMAPPED rid; streams
+                # from pre-adaptive snapshots get fresh defaults (full
+                # gamma — exactly what their era's admission reserved).
+                if rid in snap.spec_ema:
+                    self._spec_ema[new_rid] = float(snap.spec_ema[rid])
+                if rid in snap.spec_eff:
+                    self._spec_eff_last[new_rid] = int(snap.spec_eff[rid])
+                if rid in snap.spec_reserve:
+                    self._spec_reserve[new_rid] = int(
+                        snap.spec_reserve[rid])
             lens[tgt] = int(snap.lens[src_slot])
             last[tgt] = int(snap.last[src_slot])
             if lens[tgt] < len(self._slot_prompt[tgt]):
@@ -3941,6 +4238,15 @@ class ContinuousBatcher:
             raise ValueError(
                 "replica_stats() requires kv_layout='paged' (the fleet "
                 "tier routes on page watermarks)")
+        if self.spec:
+            # Accept counters mutate under _obs_mu in the dispatch
+            # commit loop — pair them from one instant so a stats read
+            # racing a step never tears proposed against accepted.
+            with self._obs_mu:
+                spec_proposed = self._spec_proposed
+                spec_accepted = self._spec_accepted
+        else:
+            spec_proposed = spec_accepted = 0
         return {
             "page_size": self.page_size,
             "pages_total": self._alloc.n_pages - 1,
@@ -3973,6 +4279,12 @@ class ContinuousBatcher:
             # default-tolerant summary convention).
             "dram_cached_pages": (len(self._tier)
                                   if self._tier is not None else 0),
+            # Speculation health (0.0 on non-spec replicas): lifetime
+            # proposals-accepted ratio — routers can prefer replicas
+            # whose current traffic mix speculates well.
+            "spec_accept_rate": (
+                round(spec_accepted / spec_proposed, 4)
+                if spec_proposed else 0.0),
         }
 
     def cache_digest(self, top_k: int = 8,
@@ -4112,6 +4424,32 @@ class ContinuousBatcher:
                     self._spec_emitted / self._spec_slot_steps
                     if self._spec_slot_steps else 0.0)
                 out["spec_rewound_tokens_total"] = float(self._spec_rewound)
+                # Which proposal source feeds the verify (exporter:
+                # label on the accept-rate histogram) and the adaptive
+                # effective-window spread across active slots —
+                # min/mean/max of the last dispatched windows
+                # (tpu_serve_spec_gamma{slot_agg=}). Non-adaptive
+                # engines report the flat gamma on all three.
+                out["spec_proposer"] = self._proposer.name
+                effs = ([self._spec_eff_last[r]
+                         for r in self._slot_req.values()
+                         if r in self._spec_eff_last]
+                        if self.spec_adaptive else [])
+                if not effs:
+                    effs = [self.gamma if not self.spec_adaptive
+                            else self._spec_overshoot()]
+                out["spec_gamma_agg"] = {
+                    "min": float(min(effs)),
+                    "mean": float(sum(effs) / len(effs)),
+                    "max": float(max(effs)),
+                }
+                # Per-dispatch accept rates, drained exactly once in the
+                # same lock snapshot (the phase-batch contract):
+                # export_serving_pool folds them into the
+                # proposer-labeled tpu_serve_spec_accept histogram.
+                if self._spec_accept_buf:
+                    out["spec_accept_batch"] = tuple(self._spec_accept_buf)
+                    self._spec_accept_buf.clear()
             if self._phase_buf:
                 out["phase_durations"] = tuple(self._phase_buf)
                 self._phase_buf.clear()
@@ -4200,6 +4538,11 @@ class ContinuousBatcher:
                 del self._slot_req[slot]
                 del self._budget[req_id]
                 self._eos_scanned.pop(req_id, None)
+                if self.spec:
+                    self._proposer.drop(slot)
+                    self._spec_ema.pop(req_id, None)
+                    self._spec_eff_last.pop(req_id, None)
+                    self._spec_reserve.pop(req_id, None)
                 t_rp = self._clock.monotonic()
                 if self.layout == "paged":
                     # Early stop returns the whole worst-case reservation

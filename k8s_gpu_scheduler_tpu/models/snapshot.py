@@ -32,10 +32,15 @@ serialized:
   engine so a post-preemption investigation can read the black box.
 
 What is deliberately NOT preserved: speculative proposals (recomputed
-from the token mirrors — the bigram index is a pure function of
+from the token mirrors — the proposer indexes are pure functions of
 prompt + emitted stream), deferred readbacks (drain flushes them), and
 cumulative gauge counters (a restored engine starts fresh counters; the
-``requests_resumed_total`` gauge records the handoff).
+``requests_resumed_total`` gauge records the handoff). Adaptive-gamma
+state IS preserved (``spec_ema``/``spec_eff``/``spec_reserve`` per
+request plus the fleet EMA): the accept-rate history is cheap to carry
+and the pinned per-request page reservation is load-bearing — the
+restored engine's effective verify windows must keep honoring the page
+math the source engine admitted under.
 
 Snapshots are MESH-AGNOSTIC by construction: drain gathers the full
 kv-head dim of every shipped page to host, so the payload carries no
@@ -143,6 +148,17 @@ class ServingSnapshot:
     tier_v: Optional[np.ndarray] = None
     tier_ks: Optional[np.ndarray] = None   # [L, R2, ps, Hkv, 1] (int8)
     tier_vs: Optional[np.ndarray] = None
+    # Adaptive speculative gamma (serving ``spec_adaptive=True``): per-
+    # request accept-rate EMAs, last effective windows, and the PINNED
+    # overshoot-row reservations admission sized each request's pages
+    # for, plus the fleet-level EMA that seeds new admissions. All
+    # default-empty/1.0: pre-adaptive snapshots load unchanged and
+    # non-adaptive engines ship empty dicts (the full gamma is then the
+    # implicit reservation, exactly what their admission reserved).
+    spec_ema: Dict[int, float] = field(default_factory=dict)
+    spec_eff: Dict[int, int] = field(default_factory=dict)
+    spec_reserve: Dict[int, int] = field(default_factory=dict)
+    spec_fleet_ema: float = 1.0
 
     # -- derived -----------------------------------------------------------
     @property
@@ -264,6 +280,15 @@ class ServingSnapshot:
             # convention): the payload arrays ride the pytree like the
             # page payload; empty tiers ship nothing.
             "tier_keys": [int(k) for k in self.tier_keys],
+            # Adaptive-gamma sidecar (absent-tolerant on load, same
+            # convention): int-keyed dicts as pair lists.
+            "spec_ema": [[int(r), float(v)]
+                         for r, v in self.spec_ema.items()],
+            "spec_eff": [[int(r), int(v)]
+                         for r, v in self.spec_eff.items()],
+            "spec_reserve": [[int(r), int(v)]
+                             for r, v in self.spec_reserve.items()],
+            "spec_fleet_ema": float(self.spec_fleet_ema),
         }
 
     def to_pytree(self) -> Dict[str, np.ndarray]:
@@ -355,6 +380,13 @@ class ServingSnapshot:
                      if "tier_ks" in tree else None),
             tier_vs=(np.asarray(tree["tier_vs"])
                      if "tier_vs" in tree else None),
+            spec_ema={int(r): float(v)
+                      for r, v in doc.get("spec_ema", [])},
+            spec_eff={int(r): int(v)
+                      for r, v in doc.get("spec_eff", [])},
+            spec_reserve={int(r): int(v)
+                          for r, v in doc.get("spec_reserve", [])},
+            spec_fleet_ema=float(doc.get("spec_fleet_ema", 1.0)),
         )
         snap.validate()
         return snap

@@ -471,6 +471,46 @@ class TestServingPoolExport:
         assert "# HELP tpu_serve_spec_accept_rate" in text
         assert set(snapshot) <= set(SERVING_POOL_GAUGES)
 
+    def test_spec_gamma_agg_and_accept_histogram(self):
+        """The adaptive-gamma spread rides one gauge under {slot_agg=},
+        the per-dispatch accept batch a proposer-labeled histogram —
+        both registered lazily, so a snapshot without the keys leaves
+        the exposition byte-identical to before."""
+        from k8s_gpu_scheduler_tpu.metrics import export_serving_pool
+        from k8s_gpu_scheduler_tpu.metrics.exporter import (
+            SPEC_ACCEPT_HISTOGRAM, SPEC_GAMMA_GAUGE,
+        )
+
+        reg = Registry()
+        export_serving_pool(reg, {
+            "spec_accept_rate": 0.5,
+            "spec_proposer": "bigram",
+            "spec_gamma_agg": {"min": 1.0, "mean": 2.5, "max": 4.0},
+            "spec_accept_batch": (0.0, 0.5, 1.0),
+        })
+        text = reg.expose()
+        assert f'{SPEC_GAMMA_GAUGE}{{slot_agg="min"}} 1.0' in text
+        assert f'{SPEC_GAMMA_GAUGE}{{slot_agg="mean"}} 2.5' in text
+        assert f'{SPEC_GAMMA_GAUGE}{{slot_agg="max"}} 4.0' in text
+        assert (f'{SPEC_ACCEPT_HISTOGRAM}_bucket'
+                f'{{le="0.5",proposer="bigram"}} 2') in text
+        assert (f'{SPEC_ACCEPT_HISTOGRAM}_count'
+                f'{{proposer="bigram"}} 3') in text
+        # The special keys never leak as plain gauges...
+        assert "tpu_serve_spec_gamma_agg" not in text
+        assert "tpu_serve_spec_accept_batch" not in text
+        assert "tpu_serve_spec_proposer" not in text
+        # ...and without them the exposition is byte-identical to the
+        # pre-speculation-sampling format (lazy registration).
+        reg_old = Registry()
+        export_serving_pool(reg_old, {"spec_accept_rate": 0.5})
+        reg_new = Registry()
+        export_serving_pool(reg_new, {"spec_accept_rate": 0.5,
+                                      "spec_proposer": "bigram",
+                                      "spec_accept_batch": ()})
+        assert reg_old.expose() == reg_new.expose()
+        assert f"{SPEC_ACCEPT_HISTOGRAM}_bucket" not in reg_old.expose()
+
     def test_lifecycle_gauges_exported(self):
         """The robustness gauges (drain/restore/resume/watchdog/error
         isolation) ride the same map: names match the PR contract
@@ -610,6 +650,12 @@ class TestServingPoolExport:
         # rewound total is (gamma - accepted) summed — present and
         # consistent with the accept counters either way.
         assert "tpu_serve_spec_rewound_tokens_total" in text
+        # A non-adaptive engine publishes the flat configured gamma on
+        # all three slot_agg series, and the drained per-dispatch accept
+        # batch lands in the proposer-labeled histogram.
+        assert 'tpu_serve_spec_gamma{slot_agg="mean"} 2.0' in text
+        assert ('tpu_serve_spec_accept_count'
+                '{proposer="bigram"}') in text
 
     def test_live_engine_snapshot_exports(self):
         """End to end against a real paged engine with the prefix cache:

@@ -390,13 +390,19 @@ class TestSpeculativeEngine:
         params = init_params(cfg, jax.random.PRNGKey(0))
         with pytest.raises(ValueError, match="paged"):
             ContinuousBatcher(params, cfg, speculative=True)
-        with pytest.raises(ValueError, match="greedy"):
-            ContinuousBatcher(params, cfg, kv_layout="paged",
-                              max_len=64, speculative=True,
-                              temperature=0.7)
+        # The greedy-only guard is GONE: temperature > 0 speculation now
+        # routes through the rejection-sampling verify and must construct.
+        eng = ContinuousBatcher(params, cfg, kv_layout="paged",
+                                max_len=64, speculative=True,
+                                temperature=0.7)
+        assert eng.spec and eng.temperature == 0.7
         with pytest.raises(ValueError, match="gamma"):
             ContinuousBatcher(params, cfg, kv_layout="paged",
                               max_len=64, speculative=True, gamma=0)
+        with pytest.raises(ValueError, match="proposer"):
+            ContinuousBatcher(params, cfg, kv_layout="paged",
+                              max_len=64, speculative=True,
+                              proposer="markov-chain")
 
     def test_overshoot_reserved_in_admission_math(self):
         """submit() must account the gamma overshoot: a request that fits
@@ -413,6 +419,270 @@ class TestSpeculativeEngine:
         eng.submit(list(range(8)), max_new=21)       # 8 + 20 + 4 == 32
         with pytest.raises(ValueError, match="exceeds"):
             eng.submit(list(range(8)), max_new=22)   # ... == 33 > 32
+
+
+class TestSpeculativeSampling:
+    """temperature > 0 speculation: the rejection-sampling verify must
+    leave the emitted stream distributed EXACTLY as the plain target
+    sampler — delta-q accept prob p[prop] for deterministic proposers,
+    min(1, p/q) + residual resample for distributional ones — while the
+    temperature == 0 configs keep compiling to the byte-identical
+    exact-match cumprod.
+
+    The tiny random-weight model's logits are nearly flat (std ~0.15
+    over vocab 256), so \"low temperature\" here means low relative to
+    THAT scale: T = 0.005 sharpens p enough for the repetitive-stream
+    proposals to accept, the regime a real model reaches at ordinary
+    temperatures."""
+
+    def _cfg(self, **kw):
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig
+
+        return dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                                   **kw)
+
+    def _run(self, cfg, prompts, spec, max_new=8, gamma=3, **kw):
+        from k8s_gpu_scheduler_tpu.models import init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                                chunk=4, prefill_bucket=8,
+                                kv_layout="paged", page_size=8,
+                                speculative=spec, gamma=gamma, **kw)
+        ids = [eng.submit(p, max_new=max_new) for p in prompts]
+        done = eng.run()
+        return [done[i] for i in ids], eng
+
+    def test_topk1_sampled_equals_greedy_with_zero_accepts(self):
+        """top_k=1 collapses the target law to a point mass: the sampled
+        engine must emit the plain greedy stream byte-for-byte, and on
+        the no-bigram-repeat prompt every proposal rejects EXACTLY
+        (accept prob is p[prop] ∈ {0, 1}) — the sampled edition of the
+        0-accept full-rewind pins."""
+        cfg = self._cfg(decode_attn="fused")
+        rng = np.random.default_rng(2)
+        prompts = [list(rng.integers(0, cfg.vocab, 5))]
+        s, eng = self._run(cfg, prompts, True, max_new=5, gamma=3,
+                           temperature=0.7, top_k=1)
+        g, _ = self._run(cfg, prompts, False, max_new=5, gamma=3)
+        assert s == g
+        m = eng.pool_metrics()
+        assert m["spec_accept_rate"] == 0.0
+        assert m["spec_tokens_per_dispatch"] == 1.0
+        assert m["spec_rewound_tokens_total"] == 3 * 4  # gamma × steps
+        eng._alloc.assert_consistent()
+
+    def test_sampled_speculation_accepts_on_repetitive_stream(self):
+        """At a temperature well under the logit scale the sampled
+        stream self-repeats like the greedy one, the delta-q accept prob
+        p[prop] approaches 1 on in-cycle proposals, and the engine must
+        beat one token per dispatch — the sampled speedup exists."""
+        cfg = self._cfg(decode_attn="fused")
+        rng = np.random.default_rng(0)
+        phrase = list(rng.integers(0, cfg.vocab, 4))
+        prompts = [phrase * 2, phrase + phrase[:1]]
+        s, eng = self._run(cfg, prompts, True, max_new=24,
+                           temperature=0.005)
+        m = eng.pool_metrics()
+        assert m["spec_accept_rate"] > 0
+        assert m["spec_tokens_per_dispatch"] > 1.0
+        assert all(0 <= t < cfg.vocab for out in s for t in out)
+        assert m["pages_in_use"] == 0
+        eng._alloc.assert_consistent()
+
+    def test_draft_equals_target_full_accepts(self):
+        """A draft proposer sharing the target's weights and sampler
+        settings yields q == p (up to float noise between the dense
+        draft forward and the paged verify): min(1, p/q) accepts every
+        proposal, every dispatch commits gamma+1 tokens, and the bonus
+        token rides the full-accept branch."""
+        from k8s_gpu_scheduler_tpu.models import init_params
+        from k8s_gpu_scheduler_tpu.models.proposers import (
+            DraftModelProposer,
+        )
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = self._cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        draft = DraftModelProposer(cfg, params, temperature=0.7,
+                                   top_k=0, ctx=32)
+        eng = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                                chunk=4, prefill_bucket=8,
+                                kv_layout="paged", page_size=8,
+                                speculative=True, gamma=3,
+                                proposer=draft, temperature=0.7)
+        rng = np.random.default_rng(1)
+        # 9 = 1 prefill token + 2 full-accept dispatches × (gamma+1):
+        # no budget clamp, so the pins are exact.
+        rid = eng.submit(list(rng.integers(0, cfg.vocab, 6)), max_new=9)
+        done = eng.run()
+        m = eng.pool_metrics()
+        assert m["spec_accept_rate"] == 1.0
+        assert m["spec_tokens_per_dispatch"] == 4.0
+        assert len(done[rid]) == 9
+        eng._alloc.assert_consistent()
+
+    def test_sampled_stream_matches_target_distribution(self):
+        """Seeded distributional equivalence on a toy vocab: across many
+        seeds the B=1 rejection sampler's emitted tokens must match the
+        EXACT target marginals — softmax(logits/T) for the first token,
+        the one-step chain marginal for the second (which rides the
+        propose/accept/resample loop). Total-variation distance against
+        the enumerated truth stays at the multinomial noise floor
+        (~0.08 for 16 symbols × 400 draws); a biased acceptance rule
+        (e.g. always committing proposals) lands near 0.9."""
+        from k8s_gpu_scheduler_tpu.models import (
+            generate_speculative, init_params,
+        )
+        from k8s_gpu_scheduler_tpu.models.llama import forward
+
+        cfg = self._cfg(vocab=16)
+        params = init_params(cfg, jax.random.PRNGKey(3))
+        rng = np.random.default_rng(5)
+        phrase = list(rng.integers(0, 16, 3))
+        prompt = jnp.asarray(phrase * 3, jnp.int32)[None, :]
+
+        gen = jax.jit(lambda s: generate_speculative(
+            params, prompt, cfg, max_new=2, gamma=2, max_len=24,
+            temperature=1.0, seed=s))
+        N = 400
+        draws = np.stack([np.asarray(gen(s)) for s in range(N)])[:, 0]
+
+        p1 = np.asarray(jax.nn.softmax(
+            forward(params, prompt, cfg)[0, -1].astype(jnp.float32)))
+        p2 = np.zeros(16)
+        for t1 in range(16):
+            ext = jnp.concatenate(
+                [prompt, jnp.asarray([[t1]], jnp.int32)], axis=1)
+            p2 += p1[t1] * np.asarray(jax.nn.softmax(
+                forward(params, ext, cfg)[0, -1].astype(jnp.float32)))
+
+        emp1 = np.bincount(draws[:, 0], minlength=16) / N
+        emp2 = np.bincount(draws[:, 1], minlength=16) / N
+        tv1 = 0.5 * np.abs(emp1 - p1).sum()
+        tv2 = 0.5 * np.abs(emp2 - p2).sum()
+        assert tv1 < 0.2, f"first-token TV {tv1:.3f} off the target law"
+        assert tv2 < 0.2, f"second-token TV {tv2:.3f} off the target law"
+
+    def test_ngram_proposer_keeps_greedy_identity(self):
+        """Proposal sources never change WHAT a greedy engine emits,
+        only how fast: an ngram-proposer engine must match plain greedy
+        byte-for-byte."""
+        cfg = self._cfg(decode_attn="fused")
+        rng = np.random.default_rng(0)
+        phrase = list(rng.integers(0, cfg.vocab, 4))
+        prompts = [phrase * 2, list(rng.integers(0, cfg.vocab, 7))]
+        s, eng = self._run(cfg, prompts, True, proposer="ngram:3")
+        g, _ = self._run(cfg, prompts, False)
+        assert s == g
+        assert eng.pool_metrics()["spec_proposer"] == "3gram"
+        eng._alloc.assert_consistent()
+
+
+class TestAdaptiveGamma:
+    """spec_adaptive=True: the accept-rate EMA drives per-slot effective
+    windows 0..gamma while the dispatch stays padded to the static
+    1+gamma shape — stream content is NEVER a function of eff, and the
+    adaptive state must ride snapshots across drain/restore/absorb."""
+
+    def _engine(self, params, cfg, **kw):
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        return ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                                 chunk=4, prefill_bucket=8,
+                                 kv_layout="paged", page_size=8,
+                                 speculative=True, gamma=3,
+                                 spec_adaptive=True, **kw)
+
+    def test_adaptive_greedy_stream_identical(self):
+        """Shrinking a verify window only forgoes speedup: the greedy
+        adaptive engine must stay byte-identical to plain greedy while
+        the gamma gauge actually moves off the static configuration."""
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+        from k8s_gpu_scheduler_tpu.models.serving import ContinuousBatcher
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                                  decode_attn="fused")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(2)
+        prompts = [list(rng.integers(0, cfg.vocab, 5)),
+                   list(rng.integers(0, cfg.vocab, 7))]
+
+        def run(eng):
+            ids = [eng.submit(p, max_new=16) for p in prompts]
+            done = eng.run()
+            return [done[i] for i in ids]
+
+        adaptive = self._engine(params, cfg)
+        s = run(adaptive)
+        plain = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                                  chunk=4, prefill_bucket=8,
+                                  kv_layout="paged", page_size=8)
+        assert s == run(plain)
+        # Reject-heavy traffic must have CLOSED windows (the speedup
+        # knob works) without ever reopening past the configured gamma.
+        m = adaptive.pool_metrics()
+        assert m["spec_gamma_agg"]["max"] <= 3
+        assert adaptive._spec_fleet_ema < 1.0
+        adaptive._alloc.assert_consistent()
+
+    def test_adaptive_state_rides_snapshot_and_absorb(self):
+        """drain() carries the per-request EMAs, pinned reservations and
+        the fleet prior through the pytree codec; restore() resumes them
+        verbatim and absorb() remaps them to the destination's new
+        request ids."""
+        from k8s_gpu_scheduler_tpu.models import LlamaConfig, init_params
+        from k8s_gpu_scheduler_tpu.models.snapshot import ServingSnapshot
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                                  decode_attn="fused")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(4)
+        src = self._engine(params, cfg)
+        for plen in (6, 9):
+            src.submit(list(rng.integers(0, cfg.vocab, plen)), max_new=24)
+        for _ in range(4):                   # EMAs move off the prior
+            src.step()
+        assert src._spec_ema and src._spec_reserve
+        fleet = src._spec_fleet_ema
+        assert fleet != 1.0
+
+        # Full drain → codec round trip → restore resumes verbatim.
+        snap = ServingSnapshot.from_pytree(src.drain().to_pytree())
+        assert snap.spec_ema and snap.spec_reserve
+        assert snap.spec_fleet_ema == fleet
+        dst = self._engine(params, cfg)
+        dst.restore(snap)
+        assert dst._spec_ema == snap.spec_ema
+        assert dst._spec_reserve == snap.spec_reserve
+        assert dst._spec_fleet_ema == fleet
+        dst.run()
+        dst._alloc.assert_consistent()
+
+        # Partial shed → absorb: the adaptive state follows the request
+        # under its REMAPPED id.
+        src2 = self._engine(params, cfg)
+        rids = [src2.submit(list(rng.integers(0, cfg.vocab, 6)),
+                            max_new=16) for _ in range(2)]
+        for _ in range(3):
+            src2.step()
+        shed = src2.active_slot_ids()[:1]
+        snap2 = src2.drain(slots=shed)
+        (old_rid,) = set(snap2.slot_req.values())
+        ema, reserve = snap2.spec_ema[old_rid], snap2.spec_reserve[old_rid]
+        dst2 = self._engine(params, cfg)
+        mapping = dst2.absorb(
+            ServingSnapshot.from_pytree(snap2.to_pytree()))
+        new_rid = mapping[old_rid]
+        assert dst2._spec_ema[new_rid] == ema
+        assert dst2._spec_reserve[new_rid] == reserve
+        while dst2.pending:
+            dst2.step()
+        while src2.pending:
+            src2.step()
+        src2._alloc.assert_consistent()
+        dst2._alloc.assert_consistent()
 
 
 class TestGenerateSpeculativeFusedVerify:
@@ -436,6 +706,28 @@ class TestGenerateSpeculativeFusedVerify:
                                      gamma=4, max_len=40)
         assert jnp.array_equal(dense, ref)
         assert jnp.array_equal(fused, ref)
+
+    def test_b1_sampled_is_seed_deterministic(self):
+        """temperature > 0 routes through the rejection sampler (the
+        greedy-only guard is gone): same seed → identical stream, a
+        different seed → a different draw of the same law."""
+        from k8s_gpu_scheduler_tpu.models import (
+            LlamaConfig, generate_speculative, init_params,
+        )
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        phrase = jax.random.randint(jax.random.PRNGKey(1), (6,), 0,
+                                    cfg.vocab)
+        prompt = jnp.tile(phrase, 3)[None, :]
+        kw = dict(max_new=8, gamma=4, max_len=40, temperature=1.0)
+        a = generate_speculative(params, prompt, cfg, seed=11, **kw)
+        b = generate_speculative(params, prompt, cfg, seed=11, **kw)
+        c = generate_speculative(params, prompt, cfg, seed=12, **kw)
+        assert jnp.array_equal(a, b)
+        assert not jnp.array_equal(a, c)
+        assert a.shape == (1, 8)
+        assert bool((a >= 0).all() and (a < cfg.vocab).all())
 
     def test_b1_restriction_still_enforced(self):
         from k8s_gpu_scheduler_tpu.models import (
